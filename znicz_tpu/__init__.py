@@ -1,0 +1,40 @@
+"""znicz-tpu: a TPU-native neural-network framework with the capabilities of
+degerli/veles.znicz (Samsung VELES core + Znicz NN plugin), re-designed
+TPU-first on JAX / XLA / pjit / Pallas.
+
+Layer map (mirrors SURVEY.md §1, rebuilt for TPU):
+
+  - ``znicz_tpu.core``      — config tree, Unit/Workflow dataflow-graph engine,
+                              mutable Bool gates, seeded PRNG service, logging.
+  - ``znicz_tpu.memory``    — Array: host/device paired tensor over jax arrays
+                              with the reference's map/unmap protocol.
+  - ``znicz_tpu.backends``  — Device abstraction (TPU / CPU / virtual mesh).
+  - ``znicz_tpu.ops``       — pure-functional jnp/lax/Pallas ops (the analogue
+                              of the reference's .cl/.cu kernel trees).
+  - ``znicz_tpu.units``     — NN units: forwards (All2All*, Conv*, Pooling*,
+                              Activation*, LRN, Dropout, Kohonen, RBM, ...)
+                              and their GradientDescent* twins, Evaluators,
+                              Decision, LR scheduling.
+  - ``znicz_tpu.loader``    — Loader state machine, FullBatch/image loaders,
+                              normalizers.
+  - ``znicz_tpu.engine``    — the fused trainer: compiles a Workflow's forward
+                              chain + evaluator + GD configs into ONE jitted
+                              (and mesh-sharded) train step.
+  - ``znicz_tpu.parallel``  — mesh construction, sharding rules, collectives;
+                              replaces the reference's ZeroMQ master-slave DP
+                              with SPMD psum over ICI.
+  - ``znicz_tpu.samples``   — MNIST, CIFAR10, MnistAE, Kohonen, AlexNet
+                              workflows (BASELINE.json configs 0-4).
+
+Reference provenance: /root/reference was empty when this framework was
+written (see SURVEY.md §0); component parity targets come from
+/root/repo/BASELINE.json and SURVEY.md's reconstructed inventory.
+"""
+
+__version__ = "0.1.0"
+
+from znicz_tpu.core.config import root, Config  # noqa: F401
+from znicz_tpu.core.mutable import Bool  # noqa: F401
+from znicz_tpu.core.units import Unit, TrivialUnit  # noqa: F401
+from znicz_tpu.core.workflow import Workflow, Repeater  # noqa: F401
+from znicz_tpu.memory import Array  # noqa: F401

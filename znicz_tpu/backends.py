@@ -1,0 +1,77 @@
+"""Device abstraction (rebuild of ``veles/backends.py``).
+
+The reference discovered OpenCL/CUDA devices, owned contexts/queues and
+compiled kernels.  On TPU all of that is PJRT+XLA's job, so ``Device`` shrinks
+to: which jax backend ("tpu"/"cpu"), which jax device(s), and — the genuinely
+new part — the **mesh** used for SPMD sharding (the rebuild's replacement for
+the reference's master/slave distribution, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Device:
+    """A compute placement: one jax device for unit-at-a-time execution plus
+    an optional mesh for fused SPMD train steps."""
+
+    def __init__(self, platform: str = "auto",
+                 mesh_shape: Optional[Tuple[int, ...]] = None,
+                 mesh_axes: Sequence[str] = ("data",)) -> None:
+        import jax
+
+        if platform == "auto":
+            platform = jax.default_backend()
+        self.platform = platform
+        self.jax_devices = jax.devices(platform)
+        self.jax_device = self.jax_devices[0]
+        self._mesh = None
+        self._mesh_shape = mesh_shape
+        self._mesh_axes = tuple(mesh_axes)
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def auto(cls) -> "Device":
+        from znicz_tpu.core.config import root
+
+        return cls(platform=root.common.engine.get("backend", "auto"))
+
+    @classmethod
+    def cpu(cls) -> "Device":
+        return cls(platform="cpu")
+
+    # -- mesh ----------------------------------------------------------------
+
+    @property
+    def mesh(self):
+        """The jax Mesh for SPMD steps; defaults to all devices on one
+        ``data`` axis (pure data parallelism, the reference's only mode)."""
+        if self._mesh is None:
+            from jax.sharding import Mesh
+
+            shape = self._mesh_shape or (len(self.jax_devices),)
+            n = int(np.prod(shape))
+            devs = np.asarray(self.jax_devices[:n]).reshape(shape)
+            self._mesh = Mesh(devs, self._mesh_axes)
+        return self._mesh
+
+    def set_mesh(self, shape: Tuple[int, ...], axes: Sequence[str]) -> None:
+        self._mesh = None
+        self._mesh_shape = tuple(shape)
+        self._mesh_axes = tuple(axes)
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.jax_devices)
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.platform not in ("cpu",)
+
+    def __repr__(self) -> str:
+        return (f"Device({self.platform}, n={self.n_devices}, "
+                f"mesh_axes={self._mesh_axes})")

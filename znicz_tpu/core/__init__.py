@@ -1,0 +1,1 @@
+"""Core engine: config, mutable gates, units, workflow, PRNG, logging."""

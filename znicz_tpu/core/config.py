@@ -1,0 +1,150 @@
+"""Global dotted config tree.
+
+TPU-native rebuild of the reference's ``veles/config.py`` (SURVEY.md §2.1
+"Config"): a global attribute-tree ``root`` that sample configs mutate
+(``root.mnistr.decision.max_epochs = 3``) and that the CLI can override with
+dotted ``key.path=value`` arguments.  Unlike the reference we also support
+snapshot/restore of subtrees to plain dicts (used by the snapshotter to make
+checkpoints self-describing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, Tuple
+
+
+class Config:
+    """An attribute tree node.  Accessing an unknown attribute creates a child
+    ``Config``, so configs can be assigned deeply without pre-declaration::
+
+        root.mnist.loader.minibatch_size = 60
+    """
+
+    def __init__(self, path: str = "") -> None:
+        # NB: use object.__setattr__ to dodge our own __setattr__ guard.
+        object.__setattr__(self, "_path", path)
+        object.__setattr__(self, "_children", {})
+
+    # -- tree access ---------------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        children = object.__getattribute__(self, "_children")
+        if name not in children:
+            children[name] = Config(self._join(name))
+        return children[name]
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, dict):
+            node = Config(self._join(name))
+            node.update(value)
+            value = node
+        self._children[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        self._children.pop(name, None)
+
+    def _join(self, name: str) -> str:
+        return f"{self._path}.{name}" if self._path else name
+
+    # -- dict-ish API --------------------------------------------------------
+
+    def update(self, values: Dict[str, Any]) -> "Config":
+        """Recursively merge a plain dict into this subtree."""
+        for key, value in values.items():
+            if isinstance(value, dict):
+                child = getattr(self, key)
+                if not isinstance(child, Config):
+                    child = Config(self._join(key))
+                    self._children[key] = child
+                child.update(value)
+            else:
+                setattr(self, key, value)
+        return self
+
+    def get(self, name: str, default: Any = None) -> Any:
+        """Return a leaf value, or ``default`` if absent or still a bare node."""
+        value = self._children.get(name, default)
+        if isinstance(value, Config) and not value._children:
+            return default
+        return value
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        return iter(self._children.items())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._children
+
+    def __bool__(self) -> bool:
+        return bool(self._children)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, value in self._children.items():
+            out[key] = value.to_dict() if isinstance(value, Config) else value
+        return out
+
+    def __repr__(self) -> str:
+        return f"Config({self._path!r}: {self.to_dict()!r})"
+
+    # -- dotted-path access (CLI overrides) ----------------------------------
+
+    def set_by_path(self, dotted: str, value: Any) -> None:
+        parts = dotted.split(".")
+        node: Config = self
+        for part in parts[:-1]:
+            node = getattr(node, part)
+            if not isinstance(node, Config):
+                raise KeyError(f"{dotted}: {part} is a leaf, not a subtree")
+        setattr(node, parts[-1], value)
+
+    def get_by_path(self, dotted: str, default: Any = None) -> Any:
+        parts = dotted.split(".")
+        node: Any = self
+        for part in parts[:-1]:
+            if not isinstance(node, Config):
+                return default
+            node = node._children.get(part)
+        if not isinstance(node, Config):
+            return default
+        return node.get(parts[-1], default)
+
+
+def parse_override(arg: str) -> Tuple[str, Any]:
+    """Parse one CLI override ``a.b.c=value``; value via literal_eval with a
+    string fallback (so ``root.x.path=/tmp/foo`` works unquoted)."""
+    if "=" not in arg:
+        raise ValueError(f"override must look like key.path=value, got {arg!r}")
+    key, raw = arg.split("=", 1)
+    key = key.strip()
+    if key.startswith("root."):
+        key = key[len("root."):]
+    try:
+        value = ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        value = raw
+    return key, value
+
+
+def apply_overrides(cfg: "Config", args: list[str]) -> None:
+    for arg in args:
+        key, value = parse_override(arg)
+        cfg.set_by_path(key, value)
+
+
+#: The global config tree, mirroring the reference's ``veles.config.root``.
+root = Config("root")
+
+# Engine-wide defaults (the reference kept these under root.common.*).
+root.common.engine.seed = 1013
+root.common.engine.backend = "auto"      # "tpu" | "cpu" | "auto"
+root.common.engine.fuse = True           # compile fused train steps
+root.common.engine.precision = "float32"  # "float32" | "bfloat16" activations
+root.common.dirs.snapshots = "snapshots"
+root.common.dirs.cache = ".znicz_cache"
+root.common.dirs.datasets = "datasets"

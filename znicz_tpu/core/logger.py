@@ -1,0 +1,82 @@
+"""Logging mixin (rebuild of the reference's ``veles/logger.py``).
+
+Colored per-unit console logging; every Unit mixes this in and logs under its
+own name.  MongoDB event logging from the reference is intentionally dropped
+(documented gap — structured per-epoch metrics go through the Decision /
+bench harness instead).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+_CONFIGURED = False
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[36m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        logging.getLogger("znicz").setLevel(level)
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        _ColorFormatter("%(asctime)s %(levelname).1s %(name)s: %(message)s",
+                        datefmt="%H:%M:%S"))
+    log = logging.getLogger("znicz")
+    log.addHandler(handler)
+    log.setLevel(level)
+    log.propagate = False
+    _CONFIGURED = True
+
+
+class Logger:
+    """Mixin giving subclasses a named logger and debug/info/warning helpers."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        name = getattr(self, "name", None) or type(self).__name__
+        return logging.getLogger(f"znicz.{name}")
+
+    def debug(self, msg: str, *args) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self.logger.error(msg, *args)
+
+
+class timeit:
+    """Context manager: ``with timeit() as t: ...; t.elapsed``."""
+
+    def __enter__(self) -> "timeit":
+        self.start = time.perf_counter()
+        self.elapsed = 0.0
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self.start

@@ -1,0 +1,105 @@
+"""Linkable mutable booleans — the control-flow currency of the unit graph.
+
+Rebuild of the reference's ``veles/mutable.py`` (SURVEY.md §2.1 "Mutable
+flags"): a ``Bool`` is a tiny mutable cell whose truth value can change over
+time and that supports composition (``~a``, ``a & b``, ``a | b``) by
+*reference*, so a unit's ``gate_block`` can be wired to, e.g.,
+``~decision.complete`` once and track it forever.  Units' gates and the
+Decision's ``complete``/``improved`` flags are Bools.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+
+class Bool:
+    """A mutable boolean cell, composable by reference.
+
+    Derived Bools (from ``~``, ``&``, ``|``) recompute from their sources on
+    every truth test, so flipping a source flips every expression built on it.
+    Assignment via ``<<=`` copies the *current* value (detaching any derived
+    expression), matching the reference semantics where gates could be both
+    expressions and plain flags.
+    """
+
+    __slots__ = ("_value", "_compute", "on_change")
+
+    def __init__(self, value: bool = False) -> None:
+        self._value = bool(value)
+        self._compute: Optional[Callable[[], bool]] = None
+        self.on_change: List[Callable[["Bool"], None]] = []
+
+    # -- value ---------------------------------------------------------------
+
+    def __bool__(self) -> bool:
+        if self._compute is not None:
+            return self._compute()
+        return self._value
+
+    @property
+    def value(self) -> bool:
+        return bool(self)
+
+    def set(self, value: bool) -> None:
+        """Set a concrete value (detaches any derived expression)."""
+        value = bool(value)
+        changed = value != bool(self)
+        self._compute = None
+        self._value = value
+        if changed:
+            for cb in tuple(self.on_change):
+                cb(self)
+
+    def __ilshift__(self, value) -> "Bool":  # b <<= True / b <<= other_bool
+        self.set(bool(value))
+        return self
+
+    # -- composition (by reference) ------------------------------------------
+
+    @classmethod
+    def _derived(cls, compute: Callable[[], bool]) -> "Bool":
+        b = cls()
+        b._compute = compute
+        return b
+
+    def __invert__(self) -> "Bool":
+        return Bool._derived(lambda: not bool(self))
+
+    def __and__(self, other) -> "Bool":
+        return Bool._derived(lambda: bool(self) and bool(other))
+
+    def __or__(self, other) -> "Bool":
+        return Bool._derived(lambda: bool(self) or bool(other))
+
+    def __repr__(self) -> str:
+        kind = "derived" if self._compute is not None else "plain"
+        return f"Bool({bool(self)}, {kind})"
+
+
+class LinkableAttribute:
+    """Forwarding descriptor support: ``link_attrs`` on units stores
+    (source_object, source_name) pairs; attribute reads on the linked unit
+    resolve through to the source at access time, so rebinding the source's
+    attribute (a new jax array each step) is always visible downstream.
+
+    Implemented inside ``Unit.__getattr__``/``__setattr__``; this class only
+    holds the link record, kept as its own type for introspection/graphviz.
+    """
+
+    __slots__ = ("obj", "name", "two_way")
+
+    def __init__(self, obj, name: str, two_way: bool = False) -> None:
+        self.obj = obj
+        self.name = name
+        self.two_way = two_way
+
+    def get(self):
+        return getattr(self.obj, self.name)
+
+    def set(self, value) -> None:
+        setattr(self.obj, self.name, value)
+
+    def __repr__(self) -> str:
+        arrow = "<->" if self.two_way else "->"
+        return f"Link({arrow} {type(self.obj).__name__}.{self.name})"

@@ -1,0 +1,146 @@
+"""Unit: the dataflow-graph node (rebuild of the reference's ``veles/units.py``).
+
+Semantics preserved from the reference (SURVEY.md §2.1 "Unit graph"):
+
+  - control edges via ``link_from`` — a unit runs when *all* units it is
+    linked from have fired in the current wave;
+  - data edges via ``link_attrs`` — attribute reads forward to the source
+    unit's attribute at access time (aliasing, not copying);
+  - ``gate_block`` (don't run, don't propagate) and ``gate_skip`` (don't run,
+    but propagate) as linkable ``Bool``s;
+  - ``initialize()`` / ``run()`` lifecycle.
+
+What changed for TPU: the reference executed units on a thread pool with
+event-driven firing; device queues made that safe.  Here execution is a
+deterministic single-threaded breadth-first wave over the control graph
+(``Workflow.run``) — JAX's async dispatch already overlaps host control with
+device compute, so host threads would add nondeterminism for zero throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core.mutable import Bool, LinkableAttribute
+
+AttrLink = Union[str, Tuple[str, str]]
+
+
+class Unit(Logger):
+    """A node in the workflow graph."""
+
+    def __init__(self, workflow: Optional["Unit"] = None,
+                 name: Optional[str] = None, **kwargs) -> None:
+        # NB: bypass __setattr__ while the link tables don't exist yet.
+        object.__setattr__(self, "_linked_attrs", {})
+        self.name = name or type(self).__name__
+        self.workflow = None
+        self.links_from: Dict["Unit", bool] = {}   # unit -> fired this wave
+        self.links_to: List["Unit"] = []
+        self.gate_block = Bool(False)
+        self.gate_skip = Bool(False)
+        self._initialized = False
+        self.run_count = 0
+        self.run_time = 0.0                         # host seconds, cumulative
+        if workflow is not None:
+            workflow.add_unit(self)
+
+    # -- attribute linking ---------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        # Only called when normal lookup fails -> consult attr links.
+        links = object.__getattribute__(self, "_linked_attrs")
+        link = links.get(name)
+        if link is not None:
+            return link.get()
+        raise AttributeError(
+            f"{type(self).__name__} {getattr(self, 'name', '?')!r} has no "
+            f"attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        links = object.__getattribute__(self, "_linked_attrs")
+        link = links.get(name)
+        if link is not None and link.two_way:
+            link.set(value)
+            return
+        if link is not None:
+            # Writing a one-way linked attr detaches the link (reference
+            # allowed shadowing); warn in debug builds via logger.
+            del links[name]
+        object.__setattr__(self, name, value)
+
+    def link_attrs(self, other: "Unit", *attrs: AttrLink,
+                   two_way: bool = False) -> "Unit":
+        """Create data edges.  Each attr is either ``"name"`` (same name both
+        sides) or ``("mine", "theirs")``."""
+        for attr in attrs:
+            mine, theirs = (attr, attr) if isinstance(attr, str) else attr
+            # Drop any instance attribute that would shadow the link.
+            if mine in self.__dict__:
+                object.__delattr__(self, mine)
+            self._linked_attrs[mine] = LinkableAttribute(other, theirs,
+                                                         two_way=two_way)
+        return self
+
+    def unlink_attrs(self, *names: str) -> None:
+        for name in names:
+            self._linked_attrs.pop(name, None)
+
+    def has_linked_attr(self, name: str) -> bool:
+        return name in self._linked_attrs
+
+    # -- control linking -----------------------------------------------------
+
+    def link_from(self, *units: "Unit") -> "Unit":
+        for unit in units:
+            if unit is self:
+                raise ValueError(f"{self.name}: cannot link from itself")
+            self.links_from[unit] = False
+            if self not in unit.links_to:
+                unit.links_to.append(self)
+        return self
+
+    def unlink_from(self, *units: "Unit") -> "Unit":
+        for unit in units:
+            self.links_from.pop(unit, None)
+            if self in unit.links_to:
+                unit.links_to.remove(self)
+        return self
+
+    def unlink_all(self) -> None:
+        for unit in list(self.links_from):
+            self.unlink_from(unit)
+        for unit in list(self.links_to):
+            unit.unlink_from(self)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def initialize(self, **kwargs) -> None:
+        """Allocate state; called once by the owning workflow before run.
+        Subclasses override and should call super().initialize(**kwargs)."""
+        self._initialized = True
+
+    def run(self) -> None:
+        """Execute one firing.  Subclasses override."""
+
+    def stop(self) -> None:
+        """Called at workflow teardown; subclasses release resources here."""
+
+    @property
+    def is_initialized(self) -> bool:
+        return self._initialized
+
+    def reset_links(self) -> None:
+        for unit in self.links_from:
+            self.links_from[unit] = False
+
+    def ready(self) -> bool:
+        return all(self.links_from.values()) if self.links_from else True
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class TrivialUnit(Unit):
+    """A unit with no compute — pure control-graph plumbing."""

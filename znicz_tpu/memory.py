@@ -1,0 +1,185 @@
+"""Array: the host/device paired tensor (rebuild of ``veles/memory.py``).
+
+The reference's ``Array`` pairs a numpy host buffer with an OpenCL/CUDA device
+buffer and a lazy map/unmap sync protocol.  On TPU the device buffer is a jax
+array in HBM and transfers go through PJRT, so the protocol collapses to a
+tiny state machine:
+
+  - ``map_read()``       — make the host view current (device→host if needed)
+  - ``map_write()``      — host view current + mark host dirty
+  - ``map_invalidate()`` — mark host dirty without device→host copy
+  - ``unmap()``          — make the device copy current (host→device if dirty)
+
+Units keep their tensors as ``Array``s; inside fused jitted train steps the
+same storage is accessed as ``.devmem`` (a jax array), and the map protocol
+guards stale-host reads exactly like the reference's asserts did (SURVEY.md
+§5 "race detection").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Sync states.
+_SYNCED = 0        # host == device (or device never materialized)
+_HOST_DIRTY = 1    # host newer than device
+_DEV_DIRTY = 2     # device newer than host
+
+
+def roundup(value: int, multiple: int) -> int:
+    """Round ``value`` up to a multiple (the reference used this to pad
+    buffers to kernel tile sizes; we keep it for MXU-friendly padding)."""
+    rem = value % multiple
+    return value if rem == 0 else value + multiple - rem
+
+
+class Array:
+    """Host numpy buffer + lazy jax device buffer."""
+
+    def __init__(self, data: Optional[np.ndarray] = None) -> None:
+        self._mem: Optional[np.ndarray] = None
+        self._devmem = None          # jax.Array or None
+        self._state = _SYNCED
+        self._device = None          # znicz_tpu.backends.Device
+        if data is not None:
+            self.reset(data)
+
+    # -- allocation ----------------------------------------------------------
+
+    def reset(self, data: Optional[np.ndarray]) -> None:
+        """(Re)bind the host buffer; drops any device copy."""
+        if data is not None and not isinstance(data, np.ndarray):
+            data = np.asarray(data)
+        self._mem = data
+        self._devmem = None
+        self._state = _HOST_DIRTY if data is not None else _SYNCED
+
+    @property
+    def mem(self) -> Optional[np.ndarray]:
+        """Raw host buffer (no sync) — write via map_write/map_invalidate."""
+        return self._mem
+
+    @mem.setter
+    def mem(self, data: Optional[np.ndarray]) -> None:
+        self.reset(data)
+
+    def __bool__(self) -> bool:
+        return self._mem is not None or self._devmem is not None
+
+    # -- shape helpers -------------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        if self._mem is not None:
+            return self._mem.shape
+        if self._devmem is not None:
+            return tuple(self._devmem.shape)
+        return ()
+
+    @property
+    def dtype(self):
+        if self._mem is not None:
+            return self._mem.dtype
+        if self._devmem is not None:
+            return np.dtype(self._devmem.dtype)
+        return None
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 0
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 0
+
+    @property
+    def sample_size(self) -> int:
+        """Elements per leading-dim sample (reference: size/shape[0])."""
+        return self.size // max(1, len(self))
+
+    @property
+    def plain(self) -> np.ndarray:
+        """Flattened host view, mapped for read."""
+        self.map_read()
+        return self._mem.reshape(-1)
+
+    # -- the map/unmap protocol ----------------------------------------------
+
+    def initialize(self, device) -> None:
+        """Attach to a device (the reference allocated the device buffer
+        here; we stay lazy — first unmap materializes it)."""
+        self._device = device
+
+    def map_read(self) -> np.ndarray:
+        if self._state == _DEV_DIRTY:
+            # np.array (not asarray): asarray of a jax CPU buffer is a
+            # zero-copy READ-ONLY view, which would make map_write hand out
+            # an unwritable buffer.
+            self._mem = np.array(self._devmem)
+            self._state = _SYNCED
+        if self._mem is None:
+            raise RuntimeError("Array.map_read on empty Array")
+        return self._mem
+
+    def map_write(self) -> np.ndarray:
+        mem = self.map_read()
+        self._state = _HOST_DIRTY
+        return mem
+
+    def map_invalidate(self) -> np.ndarray:
+        """Host will be fully overwritten: skip the device→host copy."""
+        if self._mem is None and self._devmem is not None:
+            self._mem = np.empty(self._devmem.shape,
+                                 np.dtype(self._devmem.dtype))
+        if self._mem is None:
+            raise RuntimeError("Array.map_invalidate on empty Array")
+        self._state = _HOST_DIRTY
+        return self._mem
+
+    def unmap(self):
+        """Make the device copy current; returns the jax array."""
+        if self._state == _HOST_DIRTY or self._devmem is None:
+            if self._mem is None:
+                raise RuntimeError("Array.unmap on empty Array")
+            import jax
+
+            if self._device is not None:
+                self._devmem = jax.device_put(self._mem,
+                                              self._device.jax_device)
+            else:
+                self._devmem = jax.device_put(self._mem)
+            self._state = _SYNCED
+        return self._devmem
+
+    @property
+    def devmem(self):
+        """Current device buffer (syncing host→device if dirty)."""
+        return self.unmap()
+
+    @devmem.setter
+    def devmem(self, value) -> None:
+        """Adopt a freshly computed jax array as the authoritative value."""
+        self._devmem = value
+        self._state = _DEV_DIRTY
+
+    # -- numpy conveniences --------------------------------------------------
+
+    def __array__(self, dtype=None):
+        mem = self.map_read()
+        return mem.astype(dtype) if dtype is not None else mem
+
+    def __getitem__(self, idx):
+        return self.map_read()[idx]
+
+    def __setitem__(self, idx, value):
+        self.map_write()[idx] = value
+
+    def __repr__(self) -> str:
+        state = {_SYNCED: "synced", _HOST_DIRTY: "host-dirty",
+                 _DEV_DIRTY: "dev-dirty"}[self._state]
+        return f"Array(shape={self.shape}, dtype={self.dtype}, {state})"
+
+
+# The reference aliased Array as Vector.
+Vector = Array
