@@ -308,3 +308,29 @@ class TestReviewRegressions:
         k1 = prng.get("s1").jax_key(0)
         k2 = prng.get("s2").jax_key(0)
         assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_config_defaults_ignores_autovivified_reads():
+    """A mere read of a config path must not block later defaults()."""
+    from znicz_tpu.core.config import Config
+
+    c = Config("t")
+    _ = c.a.b                      # autovivified empty node
+    c.defaults({"a": {"b": 5}, "x": 1})
+    assert c.a.get("b") == 5
+    assert c.get("x") == 1
+    c2 = Config("t2")
+    c2.a.b = 7                     # user-set leaf wins
+    c2.defaults({"a": {"b": 5}})
+    assert c2.a.get("b") == 7
+
+
+def test_workflow_uniquifies_duplicate_unit_names():
+    from znicz_tpu.core.units import TrivialUnit
+    from znicz_tpu.core.workflow import Workflow
+
+    wf = Workflow(name="dupwf")
+    a = TrivialUnit(wf)
+    b = TrivialUnit(wf)
+    assert a.name != b.name
+    assert len({u.name for u in wf.units}) == len(wf.units)

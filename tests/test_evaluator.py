@@ -1,0 +1,76 @@
+"""Evaluator numerics vs numpy oracles."""
+
+import numpy as np
+
+from znicz_tpu.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from znicz_tpu.memory import Array
+
+
+def softmax_fixture(n=6, k=4, valid=5, seed=3):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(n, k)).astype(np.float32)
+    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = (e / e.sum(axis=-1, keepdims=True)).astype(np.float32)
+    labels = rng.integers(0, k, size=n).astype(np.int32)
+    return probs, labels, valid
+
+
+def test_evaluator_softmax_metrics():
+    probs, labels, valid = softmax_fixture()
+    ev = EvaluatorSoftmax(name="ev", n_classes=4)
+    ev.output = Array(probs)
+    ev.labels = Array(labels)
+    ev.batch_size = valid
+    ev.initialize(device=None)
+    ev.run()
+
+    onehot = np.eye(4, dtype=np.float32)[labels]
+    mask = (np.arange(6) < valid).astype(np.float32)[:, None]
+    want_err = (probs - onehot) * mask / valid
+    np.testing.assert_allclose(np.array(ev.err_output.map_read()), want_err,
+                               rtol=1e-5, atol=1e-6)
+
+    pred = probs.argmax(-1)
+    want_nerr = int(((pred != labels) & (np.arange(6) < valid)).sum())
+    assert ev.n_err == want_nerr
+
+    want_loss = float(-np.log(probs[np.arange(6), labels])[:valid].sum()
+                      / valid)
+    assert abs(ev.loss - want_loss) < 1e-5
+
+    conf = np.array(ev.confusion_matrix.map_read())
+    assert conf.sum() == valid
+    for i in range(valid):
+        assert conf[pred[i], labels[i]] >= 1
+
+
+def test_evaluator_softmax_padded_rows_ignored():
+    probs, labels, _ = softmax_fixture()
+    ev = EvaluatorSoftmax(name="ev2", n_classes=4)
+    ev.output = Array(probs)
+    ev.labels = Array(labels)
+    ev.batch_size = 3
+    ev.initialize(device=None)
+    ev.run()
+    err = np.array(ev.err_output.map_read())
+    assert np.all(err[3:] == 0)
+
+
+def test_evaluator_mse():
+    rng = np.random.default_rng(9)
+    y = rng.normal(size=(5, 7)).astype(np.float32)
+    t = rng.normal(size=(5, 7)).astype(np.float32)
+    ev = EvaluatorMSE(name="evm")
+    ev.output = Array(y)
+    ev.target = Array(t)
+    ev.batch_size = 4
+    ev.initialize(device=None)
+    ev.run()
+    mask = (np.arange(5) < 4).astype(np.float32)[:, None]
+    want_err = (y - t) * mask / 4
+    np.testing.assert_allclose(np.array(ev.err_output.map_read()), want_err,
+                               rtol=1e-5, atol=1e-6)
+    want_se = np.sum(np.square((y - t) * mask), axis=-1)
+    np.testing.assert_allclose(np.array(ev.mse.map_read()), want_se,
+                               rtol=1e-5, atol=1e-6)
+    assert abs(ev.loss - 0.5 * want_se.sum() / 4) < 1e-5

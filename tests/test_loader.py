@@ -1,0 +1,116 @@
+"""Loader state-machine semantics (reference: veles/loader/base.py tests)."""
+
+import numpy as np
+
+from znicz_tpu.loader.base import TEST, TRAIN, VALID
+from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+from znicz_tpu.normalization import LinearNormalizer, MeanDispNormalizer
+
+
+def make_loader(n_test=4, n_valid=6, n_train=10, mb=4, **kw):
+    ld = FullBatchLoader(name="ld", minibatch_size=mb, **kw)
+    total = n_test + n_valid + n_train
+    ld.original_data.mem = np.arange(total * 3, dtype=np.float32).reshape(
+        total, 3)
+    ld.original_labels.mem = np.arange(total, dtype=np.int32) % 5
+    ld.class_lengths = [n_test, n_valid, n_train]
+    ld.initialize(device=None)
+    return ld
+
+
+def test_epoch_walk_classes_and_tails():
+    ld = make_loader()
+    seen = []
+    for _ in range(6):   # 4/4 test=1 batch, 6/4 valid=2, 10/4 train=3
+        ld.run()
+        seen.append((ld.minibatch_class, ld.minibatch_size,
+                     ld.class_ended, ld.last_minibatch))
+    assert seen[0] == (TEST, 4, True, False)
+    assert seen[1] == (VALID, 4, False, False)
+    assert seen[2] == (VALID, 2, True, False)        # short tail, no straddle
+    assert seen[3] == (TRAIN, 4, False, False)
+    assert seen[5] == (TRAIN, 2, True, True)         # epoch tail
+    assert ld.epoch_number == 0                      # increments on next run
+    ld.run()
+    assert ld.minibatch_class == TEST                # next epoch restarts
+    assert ld.epoch_number == 1
+
+
+def test_indices_cover_each_class_exactly_once():
+    ld = make_loader()
+    got = {TEST: [], VALID: [], TRAIN: []}
+    for _ in range(6):
+        ld.run()
+        idx = np.array(ld.minibatch_indices.map_read())[:ld.minibatch_size]
+        got[ld.minibatch_class].extend(idx.tolist())
+    assert sorted(got[TEST]) == list(range(0, 4))
+    assert sorted(got[VALID]) == list(range(4, 10))
+    assert sorted(got[TRAIN]) == list(range(10, 20))
+
+
+def test_train_reshuffles_between_epochs_but_not_eval():
+    ld = make_loader(mb=10)
+    orders = []
+    for _ in range(3):   # 3 epochs of [test(1) valid(1) train(1)] @ mb=10
+        epoch = []
+        while True:
+            ld.run()
+            if ld.minibatch_class == TRAIN:
+                epoch.append(
+                    np.array(ld.minibatch_indices.mem)[:ld.minibatch_size]
+                    .copy())
+            if ld.last_minibatch:
+                break
+        orders.append(np.concatenate(epoch))
+    assert not np.array_equal(orders[0], orders[1])  # reshuffled
+    assert sorted(orders[0]) == sorted(orders[1])    # same index set
+
+
+def test_minibatch_data_gather_matches_indices():
+    ld = make_loader()
+    ld.run()
+    idx = np.array(ld.minibatch_indices.mem)
+    data = np.array(ld.minibatch_data.map_read())
+    np.testing.assert_allclose(data, ld.original_data.mem[idx])
+    labels = np.array(ld.minibatch_labels.map_read())
+    np.testing.assert_array_equal(labels, ld.original_labels.mem[idx])
+
+
+def test_mse_loader_targets_from_data():
+    ld = FullBatchLoaderMSE(name="ldmse", minibatch_size=3,
+                            targets_from_data=True)
+    ld.original_data.mem = np.random.default_rng(0).normal(
+        size=(9, 4)).astype(np.float32)
+    ld.class_lengths = [0, 3, 6]
+    ld.initialize(device=None)
+    ld.run()
+    np.testing.assert_allclose(np.array(ld.minibatch_targets.map_read()),
+                               np.array(ld.minibatch_data.map_read()))
+
+
+def test_linear_normalizer_fit_applied_on_train_only():
+    norm = LinearNormalizer()
+    ld = make_loader(normalizer=norm)
+    data = ld.original_data.map_read()
+    # fitted on train rows only (values 30..59), applied to all
+    assert norm.vmin == 30.0 and norm.vmax == 59.0
+    assert data.max() > 1.0 - 1e-6   # train max maps to 1
+    rt = {}
+    norm2 = LinearNormalizer()
+    norm2.restore(norm.state())
+    assert norm2.vmin == norm.vmin
+
+
+def test_mean_disp_normalizer_roundtrip():
+    rng = np.random.default_rng(1)
+    data = rng.normal(2.0, 3.0, size=(50, 8)).astype(np.float32)
+    norm = MeanDispNormalizer()
+    norm.fit(data)
+    d2 = data.copy()
+    norm.apply_inplace(d2)
+    assert abs(d2.mean()) < 1e-5
+    norm2 = MeanDispNormalizer()
+    norm2.restore(norm.state())
+    d3 = data.copy()
+    norm2.apply_inplace(d3)
+    np.testing.assert_allclose(d2, d3)

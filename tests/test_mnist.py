@@ -1,0 +1,98 @@
+"""End-to-end MNIST sample: the BASELINE config[0] parity gate (SURVEY.md §4
+functional tests) — seeded run, loss decreases, accuracy beats chance by a
+wide margin, snapshot->resume continues identically-shaped training."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+
+@pytest.fixture
+def small_mnist(tmp_path):
+    root.mnist.loader.n_train = 600
+    root.mnist.loader.n_valid = 120
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 3
+    root.mnist.decision.fail_iterations = 0
+    root.common.dirs.snapshots = str(tmp_path)
+    yield
+
+
+def test_mnist_trains(small_mnist):
+    from znicz_tpu.samples import mnist
+
+    wf = mnist.run()
+    dec = wf.decision
+    assert dec.epoch_number == 2                     # 3 epochs: 0,1,2
+    assert bool(dec.complete)
+    train = dec.epoch_metrics[2]
+    valid = dec.epoch_metrics[1]
+    assert train is not None and valid is not None
+    # 10-class chance is 90% err; the glyph task is easy — demand < 40%
+    assert valid["err_pct"] < 40.0, valid
+    assert dec.best_metric < 0.4
+    conf = valid["confusion"]
+    assert conf is not None and conf.sum() == 120
+
+
+def test_mnist_loss_decreases(small_mnist):
+    from znicz_tpu.samples import mnist
+
+    losses = []
+    wf = mnist.MnistWorkflow()
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    wf.initialize(device=None)
+    wf.run()
+    assert len(losses) == 3
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_mnist_deterministic(small_mnist):
+    """Same seed => identical loss trajectory (the parity property)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    def one_run():
+        prng._streams.clear()
+        prng.seed_all(1013)
+        losses = []
+        wf = mnist.MnistWorkflow()
+        wf.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        wf.initialize(device=None)
+        wf.run()
+        return losses
+
+    a, b = one_run(), one_run()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_mnist_snapshot_resume(small_mnist, tmp_path):
+    from znicz_tpu import snapshotter as snap_mod
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+    from znicz_tpu.snapshotter import Snapshotter
+
+    wf = mnist.run()
+    path = wf.snapshotter.destination
+    assert path is not None
+
+    # resume into a fresh workflow; weights must match the snapshot
+    prng._streams.clear()
+    prng.seed_all(1013)
+    root.mnist.decision.max_epochs = 5               # train 2 more epochs
+    wf2 = mnist.MnistWorkflow()
+    wf2.initialize(device=None)
+    snap = Snapshotter.load(path)
+    snap_mod.restore(wf2, snap)
+    w_loaded = np.array(wf2.forwards[0].weights.map_read())
+    np.testing.assert_allclose(w_loaded, snap["units"]["fwd0"]["weights"])
+    assert wf2.decision.best_metric == snap["decision"]["best_metric"]
+
+    wf2.run()
+    assert bool(wf2.decision.complete)
+    # resumed training should do no worse than the snapshot
+    assert wf2.decision.best_metric <= snap["decision"]["best_metric"] + 1e-9

@@ -1,0 +1,187 @@
+"""Per-op numerics vs pure-numpy oracles + gradient checks (SURVEY.md §4:
+the reference's unit-test pattern — numpy backend as ground truth, device
+backend within float tolerance; here jax-on-cpu is the device)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.all2all import (
+    All2All,
+    All2AllRELU,
+    All2AllSigmoid,
+    All2AllSoftmax,
+    All2AllStrictRELU,
+    All2AllTanh,
+)
+from znicz_tpu.gd import GD_BY_FORWARD
+from znicz_tpu.memory import Array
+from znicz_tpu.ops import activations
+
+
+def np_act(name, v):
+    if name == "tanh":
+        return 1.7159 * np.tanh(0.6666 * v)
+    if name == "relu":
+        return np.log1p(np.exp(v))
+    if name == "strict_relu":
+        return np.maximum(v, 0.0)
+    if name == "sigmoid":
+        return 1.0 / (1.0 + np.exp(-v))
+    if name == "softmax":
+        e = np.exp(v - v.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    return v
+
+
+CASES = [
+    (All2All, "linear"),
+    (All2AllTanh, "tanh"),
+    (All2AllRELU, "relu"),
+    (All2AllStrictRELU, "strict_relu"),
+    (All2AllSigmoid, "sigmoid"),
+    (All2AllSoftmax, "softmax"),
+]
+
+
+@pytest.mark.parametrize("cls,act", CASES)
+def test_all2all_forward_matches_numpy(cls, act):
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(8, 12)).astype(np.float32)
+    fwd = cls(name=f"fwd_{act}", output_sample_shape=(5,))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    w = fwd.weights.mem
+    b = fwd.bias.mem
+    want = np_act(act, x @ w.T + b)
+    got = np.array(fwd.output.map_read())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_weights_transposed_storage():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    fwd = All2All(name="fwd_t", output_sample_shape=(3,),
+                  weights_transposed=True)
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    assert fwd.weights.shape == (6, 3)
+    fwd.run()
+    want = x @ fwd.weights.mem + fwd.bias.mem
+    np.testing.assert_allclose(np.array(fwd.output.map_read()), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls,act", [c for c in CASES if c[1] != "softmax"])
+def test_gd_matches_finite_differences(cls, act):
+    """dW from the GD unit == numeric gradient of L = sum(err_output * y)."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(5, 7)).astype(np.float32)
+    err = rng.normal(size=(5, 4)).astype(np.float32)
+    fwd = cls(name=f"fd_{act}", output_sample_shape=(4,))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    w0 = fwd.weights.mem.copy()
+    b0 = fwd.bias.mem.copy()
+    fwd.run()
+
+    gd_cls = GD_BY_FORWARD[cls.__name__]
+    gd = gd_cls(name=f"gdfd_{act}", forward=fwd, learning_rate=1.0,
+                gradient_moment=0.0)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    gd.run()
+    # update was w' = w - 1.0 * dW  =>  dW = w0 - w'
+    dW = w0 - np.array(fwd.weights.map_read())
+    db = b0 - np.array(fwd.bias.map_read())
+    err_input = np.array(gd.err_input.map_read())
+
+    def loss(w, b, xx):
+        return float(np.sum(err * np_act(act, xx @ w.T + b)))
+
+    eps = 1e-3
+    for idx in [(0, 0), (1, 3), (3, 6)]:
+        wp = w0.copy(); wp[idx] += eps
+        wm = w0.copy(); wm[idx] -= eps
+        num = (loss(wp, b0, x) - loss(wm, b0, x)) / (2 * eps)
+        assert abs(num - dW[idx]) < 5e-2 * max(1.0, abs(num)), \
+            f"dW{idx}: fd={num} unit={dW[idx]}"
+    for j in [0, 2]:
+        bp = b0.copy(); bp[j] += eps
+        bm = b0.copy(); bm[j] -= eps
+        num = (loss(w0, bp, x) - loss(w0, bm, x)) / (2 * eps)
+        assert abs(num - db[j]) < 5e-2 * max(1.0, abs(num))
+    for idx in [(0, 0), (2, 5)]:
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        num = (loss(w0, b0, xp) - loss(w0, b0, xm)) / (2 * eps)
+        assert abs(num - err_input[idx]) < 5e-2 * max(1.0, abs(num))
+
+
+def test_gd_momentum_and_decay():
+    """Velocity accumulation + L2 decay follow the reference formula."""
+    x = np.ones((2, 3), np.float32)
+    err = np.ones((2, 2), np.float32)
+    fwd = All2All(name="momfwd", output_sample_shape=(2,))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    w0 = fwd.weights.mem.copy()
+    gd = GD_BY_FORWARD["All2All"](
+        name="momgd", forward=fwd, learning_rate=0.1, gradient_moment=0.5,
+        weights_decay=0.01, need_err_input=False)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    fwd.run(); gd.run()
+    g1 = err.T @ x + 0.01 * w0           # raw grad + L2
+    v1 = -0.1 * g1
+    np.testing.assert_allclose(np.array(fwd.weights.map_read()), w0 + v1,
+                               rtol=1e-5, atol=1e-6)
+    w1 = w0 + v1
+    fwd.run(); gd.run()
+    g2 = err.T @ x + 0.01 * w1
+    v2 = 0.5 * v1 - 0.1 * g2
+    np.testing.assert_allclose(np.array(fwd.weights.map_read()), w1 + v2,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_gd_is_logit_cotangent():
+    """GDSoftmax must bypass the softmax jacobian (err_output already is
+    dCE/dlogits when err = probs - onehot)."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    labels = rng.integers(0, 3, size=6)
+    fwd = All2AllSoftmax(name="smfwd", output_sample_shape=(3,))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    w0 = fwd.weights.mem.copy(); b0 = fwd.bias.mem.copy()
+    fwd.run()
+    probs = np.array(fwd.output.map_read())
+    onehot = np.eye(3, dtype=np.float32)[labels]
+    err = (probs - onehot) / 6.0
+    gd = GD_BY_FORWARD["All2AllSoftmax"](
+        name="smgd", forward=fwd, learning_rate=1.0, need_err_input=False)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    gd.run()
+    dW = w0 - np.array(fwd.weights.map_read())
+
+    def ce(w):
+        logits = x @ w.T + b0
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        p = e / e.sum(axis=-1, keepdims=True)
+        return -np.mean(np.log(p[np.arange(6), labels]))
+
+    eps = 1e-3
+    for idx in [(0, 0), (2, 3)]:
+        wp = w0.copy(); wp[idx] += eps
+        wm = w0.copy(); wm[idx] -= eps
+        num = (ce(wp) - ce(wm)) / (2 * eps)
+        assert abs(num - dW[idx]) < 1e-2 * max(1.0, abs(num))
+
+
+def test_activation_constants():
+    """The LeCun tanh constants the reference hard-codes."""
+    v = np.array([0.5], np.float32)
+    got = np.array(activations.tanh_scaled(v))
+    np.testing.assert_allclose(got, 1.7159 * np.tanh(0.6666 * 0.5),
+                               rtol=1e-6)

@@ -1,0 +1,89 @@
+"""Fully-connected forward units (rebuild of ``znicz/all2all.py``).
+
+Reference classes (SURVEY.md §2.2 "Fully connected"): ``All2All`` (linear),
+``All2AllTanh``, ``All2AllRELU`` (softplus!), ``All2AllStrictRELU``,
+``All2AllSigmoid``, ``All2AllSoftmax``.  The reference ran clBLAS/cuBLAS GEMM
+plus a bias+activation kernel; here the whole thing is one jitted
+``linear``+activation, which XLA fuses onto the MXU.
+
+``All2AllSoftmax`` additionally exports ``max_idx`` (argmax per sample) which
+the reference's evaluator consumed for n_err/confusion.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from znicz_tpu.nn_units import ForwardBase
+from znicz_tpu.ops import activations
+from znicz_tpu.ops.linear import linear
+
+
+class All2All(ForwardBase):
+    """y = activation(x @ W^T + b); output_sample_shape sets the width."""
+
+    ACTIVATION = staticmethod(activations.identity)
+
+    def __init__(self, workflow=None, name=None, output_sample_shape=(),
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = tuple(output_sample_shape)
+        self.output_samples_number = int(np.prod(self.output_sample_shape))
+
+    def output_shape_for(self, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        return (in_shape[0],) + self.output_sample_shape
+
+    def apply(self, params, x):
+        y = linear(x, params["weights"], params.get("bias"),
+                   weights_transposed=self.weights_transposed)
+        y = type(self).ACTIVATION(y)
+        return y.reshape((x.shape[0],) + self.output_sample_shape)
+
+    def initialize(self, device=None, **kwargs):
+        in_size = self.input.sample_size
+        out_size = self.output_samples_number
+        if self.weights.mem is None:
+            self.init_weights((out_size, in_size), (out_size,))
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class All2AllTanh(All2All):
+    ACTIVATION = staticmethod(activations.tanh_scaled)
+
+
+class All2AllRELU(All2All):
+    """Reference "RELU" = softplus log(1+e^x)."""
+
+    ACTIVATION = staticmethod(activations.relu_log)
+
+
+class All2AllStrictRELU(All2All):
+    ACTIVATION = staticmethod(activations.strict_relu)
+
+
+class All2AllSigmoid(All2All):
+    ACTIVATION = staticmethod(activations.sigmoid)
+
+
+class All2AllSoftmax(All2All):
+    """Output is the softmax distribution itself (reference semantics); the
+    paired GDSoftmax treats err_output as the logits cotangent."""
+
+    ACTIVATION = staticmethod(activations.softmax)
+
+    def __init__(self, workflow=None, name=None, output_sample_shape=(),
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name,
+                         output_sample_shape=output_sample_shape, **kwargs)
+        from znicz_tpu.memory import Array
+        self.max_idx = Array()
+
+    def run(self):
+        super().run()
+        import jax.numpy as jnp
+        self.max_idx.devmem = jnp.argmax(self.output.devmem, axis=-1)

@@ -67,6 +67,25 @@ class Config:
                 setattr(self, key, value)
         return self
 
+    def defaults(self, values: Dict[str, Any]) -> "Config":
+        """Like update(), but existing leaves win — sample modules use this
+        so user/CLI overrides set before import are not clobbered."""
+        for key, value in values.items():
+            existing = self._children.get(key)
+            # An empty Config node is what a mere *read* autovivifies —
+            # treat it as absent (same rule get() uses), not as user-set.
+            is_vacant = (existing is None or
+                         (isinstance(existing, Config) and not existing))
+            if isinstance(value, dict):
+                if existing is not None and isinstance(existing, Config):
+                    existing.defaults(value)
+                elif is_vacant:
+                    setattr(self, key, value)
+                # else: user set a leaf where we default a subtree — user wins
+            elif is_vacant:
+                setattr(self, key, value)
+        return self
+
     def get(self, name: str, default: Any = None) -> Any:
         """Return a leaf value, or ``default`` if absent or still a bare node."""
         value = self._children.get(name, default)

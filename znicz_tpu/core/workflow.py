@@ -54,6 +54,14 @@ class Workflow(Unit):
 
     def add_unit(self, unit: Unit) -> None:
         if unit not in self.units:
+            # Uniquify the name: snapshots and attr-link debugging key units
+            # by name, so two default-named All2AllTanh's must not collide.
+            taken = {u.name for u in self.units}
+            if unit.name in taken:
+                i = 2
+                while f"{unit.name}_{i}" in taken:
+                    i += 1
+                unit.name = f"{unit.name}_{i}"
             self.units.append(unit)
             unit.workflow = self
 

@@ -1,0 +1,117 @@
+"""Deterministic procedural datasets.
+
+This environment has no network and no MNIST/CIFAR files on disk, so the
+sample workflows (SURVEY.md §6, BASELINE configs) run on procedurally
+generated stand-ins with the same shapes and difficulty profile:
+
+  - ``digits(...)``  — 28x28 grayscale "MNIST": 10 glyph classes rendered
+    from a 5x7 bitmap font with random shift, scale jitter and noise.
+  - ``tinyimages(...)`` — 32x32x3 "CIFAR": 10 classes of parametric textures
+    (oriented gradients/blobs) with noise.
+
+Everything derives from the seeded ``prng`` streams, so loss curves are
+reproducible run-to-run — the parity property the BASELINE gates check.
+Swap in real data by pointing the sample configs' ``data_path`` at .npz
+files with arrays ``data``/``labels`` (same layout).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.core import prng
+
+# 5x7 digit font (rows of 5 bits, 0..9).
+_FONT = {
+    0: ("01110", "10001", "10011", "10101", "11001", "10001", "01110"),
+    1: ("00100", "01100", "00100", "00100", "00100", "00100", "01110"),
+    2: ("01110", "10001", "00001", "00110", "01000", "10000", "11111"),
+    3: ("01110", "10001", "00001", "00110", "00001", "10001", "01110"),
+    4: ("00010", "00110", "01010", "10010", "11111", "00010", "00010"),
+    5: ("11111", "10000", "11110", "00001", "00001", "10001", "01110"),
+    6: ("01110", "10000", "11110", "10001", "10001", "10001", "01110"),
+    7: ("11111", "00001", "00010", "00100", "01000", "01000", "01000"),
+    8: ("01110", "10001", "10001", "01110", "10001", "10001", "01110"),
+    9: ("01110", "10001", "10001", "01111", "00001", "00001", "01110"),
+}
+
+
+def _glyph(digit: int) -> np.ndarray:
+    rows = _FONT[digit]
+    return np.array([[float(c) for c in row] for row in rows], np.float32)
+
+
+def digits(n: int, *, size: int = 28, noise: float = 0.15, jitter: int = 2,
+           stream: str = "dataset.digits") -> Tuple[np.ndarray, np.ndarray]:
+    """n samples of (size, size) float32 in [0,1] + int32 labels.
+    Glyphs are roughly centered with ±jitter px shift (like real MNIST);
+    full-range translation would make the task position-only and unlearnable
+    for the MLP samples."""
+    gen = prng.get(stream)
+    rng = gen.state
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    data = np.zeros((n, size, size), np.float32)
+    for i in range(n):
+        g = _glyph(int(labels[i]))
+        scale = int(rng.integers(2, 4))                 # 2x or 3x upscale
+        big = np.kron(g, np.ones((scale, scale), np.float32))
+        h, w = big.shape
+        cr, cc = (size - h) // 2, (size - w) // 2
+        r = int(np.clip(cr + rng.integers(-jitter, jitter + 1),
+                        0, size - h))
+        c = int(np.clip(cc + rng.integers(-jitter, jitter + 1),
+                        0, size - w))
+        img = np.zeros((size, size), np.float32)
+        img[r:r + h, c:c + w] = big * float(rng.uniform(0.6, 1.0))
+        img += rng.normal(0.0, noise, size=(size, size)).astype(np.float32)
+        data[i] = np.clip(img, 0.0, 1.0)
+    return data, labels
+
+
+def tinyimages(n: int, *, size: int = 32, noise: float = 0.2,
+               stream: str = "dataset.tiny") -> Tuple[np.ndarray, np.ndarray]:
+    """n samples of (size, size, 3) float32 in [0,1] + int32 labels.
+    Classes are parametric textures: oriented sinusoid gratings (0-4) and
+    gaussian blobs in distinct color channels / positions (5-9)."""
+    gen = prng.get(stream)
+    rng = gen.state
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    data = np.zeros((n, size, size, 3), np.float32)
+    for i in range(n):
+        k = int(labels[i])
+        img = np.zeros((size, size, 3), np.float32)
+        phase = float(rng.uniform(0, 2 * np.pi))
+        if k < 5:
+            angle = k * np.pi / 5 + float(rng.normal(0, 0.08))
+            freq = 3.0 + k
+            wave = 0.5 + 0.5 * np.sin(
+                2 * np.pi * freq * (xx * np.cos(angle) + yy * np.sin(angle))
+                + phase)
+            color = np.array([0.9, 0.5 + 0.1 * k, 0.3], np.float32)
+            img = wave[..., None] * color
+        else:
+            cx = 0.2 + 0.15 * (k - 5) + float(rng.normal(0, 0.03))
+            cy = 0.3 + 0.1 * (k - 5) + float(rng.normal(0, 0.03))
+            sigma = 0.08 + 0.02 * (k - 5)
+            blob = np.exp(-(np.square(xx - cx) + np.square(yy - cy))
+                          / (2 * sigma ** 2))
+            chan = (k - 5) % 3
+            img[..., chan] = blob
+            img[..., (chan + 1) % 3] = 0.3 * blob
+        img += rng.normal(0.0, noise, size=img.shape).astype(np.float32)
+        data[i] = np.clip(img, 0.0, 1.0)
+    return data, labels
+
+
+def load_or_generate(path: Optional[str], generator, *args, **kwargs):
+    """If ``path`` exists, load arrays ``data``/``labels`` from the .npz;
+    otherwise call the generator (the no-real-data fallback)."""
+    if path and os.path.exists(path):
+        with np.load(path) as f:
+            return (np.asarray(f["data"], np.float32),
+                    np.asarray(f["labels"], np.int32))
+    return generator(*args, **kwargs)

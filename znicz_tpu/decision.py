@@ -1,0 +1,177 @@
+"""Decision units: training control (rebuild of ``znicz/decision.py``).
+
+Runs once per minibatch, right after the evaluator.  Accumulates per-class
+epoch statistics, and at epoch end (the loader's TRAIN tail):
+
+  - tracks the best validation metric (n_err for GD, mse for MSE),
+  - raises ``improved`` (the snapshotter's trigger),
+  - raises ``complete`` when ``max_epochs`` is reached or validation hasn't
+    improved for ``fail_iterations`` epochs,
+  - maintains ``gd_skip`` — the Bool that gates every GD unit off for
+    TEST/VALID minibatches (only TRAIN minibatches backprop; reference
+    semantics).
+
+Class indices follow the reference: TEST=0, VALID=1, TRAIN=2; the loader
+serves one full pass over test, then valid, then train per epoch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.units import Unit
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "valid", "train")
+
+
+class DecisionBase(Unit):
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.max_epochs = kwargs.get("max_epochs", 10)
+        #: epochs without validation improvement before stopping (0 = off)
+        self.fail_iterations = kwargs.get("fail_iterations", 0)
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.gd_skip = Bool(False)
+        # linked from loader:
+        self.minibatch_class = TRAIN
+        self.last_minibatch = False
+        self.class_ended = False
+        self.epoch_number = 0
+        self.class_lengths: List[int] = [0, 0, 0]
+        # linked from evaluator:
+        self.minibatch_loss = 0.0
+        # epoch accumulators / history
+        self.epoch_metrics = [None, None, None]   # last finished epoch
+        self._acc_loss = [0.0, 0.0, 0.0]
+        self._acc_batches = [0, 0, 0]
+        self.best_metric = np.inf
+        self.best_epoch = -1
+        self._fails = 0
+        self.on_epoch_end = []                    # callbacks(decision)
+
+    # -- metric plumbing (subclasses refine) ----------------------------------
+
+    def _accumulate(self, klass: int) -> None:
+        self._acc_loss[klass] += float(self.minibatch_loss)
+        self._acc_batches[klass] += 1
+
+    def _class_metric(self, klass: int) -> float:
+        b = max(1, self._acc_batches[klass])
+        return self._acc_loss[klass] / b
+
+    def _reset_class(self, klass: int) -> None:
+        self._acc_loss[klass] = 0.0
+        self._acc_batches[klass] = 0
+
+    def _validation_class(self) -> int:
+        """Improvement is judged on VALID if present, else TRAIN."""
+        return VALID if self.class_lengths[VALID] else TRAIN
+
+    def improvement_metric(self) -> float:
+        return self._class_metric(self._validation_class())
+
+    # -- run ------------------------------------------------------------------
+
+    def run(self):
+        klass = int(self.minibatch_class)
+        self._accumulate(klass)
+        self.epoch_ended.set(False)
+        if self.class_ended:
+            self.epoch_metrics[klass] = self._summarize(klass)
+        if self.last_minibatch:            # end of TRAIN == end of epoch
+            metric = self.improvement_metric()
+            if metric < self.best_metric - 1e-12:
+                self.best_metric = metric
+                self.best_epoch = int(self.epoch_number)
+                self.improved.set(True)
+                self._fails = 0
+            else:
+                self.improved.set(False)
+                self._fails += 1
+            done = (self.epoch_number + 1 >= self.max_epochs or
+                    (self.fail_iterations and
+                     self._fails >= self.fail_iterations))
+            self.complete.set(done)
+            self.epoch_ended.set(True)
+            self._log_epoch()
+            for cb in self.on_epoch_end:
+                cb(self)
+            for k in (TEST, VALID, TRAIN):
+                self._reset_class(k)
+        # GD units run only on TRAIN minibatches while not complete.
+        self.gd_skip.set(klass != TRAIN or bool(self.complete))
+
+    def _summarize(self, klass: int):
+        return {"loss": self._class_metric(klass)}
+
+    def _log_epoch(self):
+        parts = []
+        for k in (TEST, VALID, TRAIN):
+            if self.class_lengths[k] and self.epoch_metrics[k] is not None:
+                m = self.epoch_metrics[k]
+                stats = ", ".join(
+                    f"{key}={val:.6g}" if isinstance(val, float)
+                    else f"{key}={val}"
+                    for key, val in m.items()
+                    if isinstance(val, (int, float)) and key != "confusion")
+                parts.append(f"{CLASS_NAMES[k]}: {stats}")
+        self.info("epoch %d  %s%s", self.epoch_number, "  ".join(parts),
+                  "  *" if bool(self.improved) else "")
+
+
+class DecisionGD(DecisionBase):
+    """Classification: tracks n_err% per class + confusion matrix; judges
+    improvement on validation error count."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        # linked from evaluator:
+        self.minibatch_n_err = 0
+        self.confusion_matrix = None
+        self.max_err_output_sum = 0.0
+        self._acc_n_err = [0, 0, 0]
+        self._acc_samples = [0, 0, 0]
+        self._acc_confusion: List[Optional[np.ndarray]] = [None, None, None]
+        self.minibatch_size = 0
+
+    def _accumulate(self, klass: int) -> None:
+        super()._accumulate(klass)
+        self._acc_n_err[klass] += int(self.minibatch_n_err)
+        self._acc_samples[klass] += int(self.minibatch_size)
+        if self.confusion_matrix is not None:
+            conf = np.asarray(self.confusion_matrix)
+            if self._acc_confusion[klass] is None:
+                self._acc_confusion[klass] = conf.copy()
+            else:
+                self._acc_confusion[klass] += conf
+
+    def _reset_class(self, klass: int) -> None:
+        super()._reset_class(klass)
+        self._acc_n_err[klass] = 0
+        self._acc_samples[klass] = 0
+        self._acc_confusion[klass] = None
+
+    def improvement_metric(self) -> float:
+        k = self._validation_class()
+        return self._acc_n_err[k] / max(1, self._acc_samples[k])
+
+    def _summarize(self, klass: int):
+        n = max(1, self._acc_samples[klass])
+        return {"loss": self._class_metric(klass),
+                "n_err": self._acc_n_err[klass],
+                "err_pct": 100.0 * self._acc_n_err[klass] / n,
+                "confusion": self._acc_confusion[klass]}
+
+
+class DecisionMSE(DecisionBase):
+    """Regression/autoencoder: improvement on validation mean loss."""
+
+    def _summarize(self, klass: int):
+        return {"loss": self._class_metric(klass),
+                "mse": self._class_metric(klass)}

@@ -1,0 +1,129 @@
+"""Evaluators: loss + metrics + the err_output that seeds the GD chain
+(rebuild of ``znicz/evaluator.py``, SURVEY.md §2.2 "Evaluators").
+
+``EvaluatorSoftmax`` — consumes the softmax output of ``All2AllSoftmax``:
+  - ``err_output = (probs - onehot(labels)) / n_valid`` (the CE cotangent at
+    the logits, batch-mean scaled — the reference's fused softmax+CE backward)
+  - ``n_err`` (misclassified count), ``confusion_matrix``, ``loss`` (mean CE),
+    ``max_err_output_sum`` (reference's divergence monitor).
+
+``EvaluatorMSE`` — for regression/autoencoders:
+  - ``err_output = (output - target) / n_valid`` — exactly the gradient of
+    ``loss = 0.5 · Σ_samples ||y-t||² / n_valid``, which is what ``loss``
+    reports (so the loss curve is the integral of the served gradient);
+  - ``mse`` = per-sample squared error ``||y-t||²`` (sum over features).
+
+Padded tail minibatches: the loader serves fixed-size minibatches with
+``minibatch_size <= max_minibatch_size``; rows past minibatch_size are masked
+out of both err_output and all metrics (reference semantics, SURVEY.md §7
+hard part 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.memory import Array
+
+
+class EvaluatorBase(Unit):
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.output: Optional[Array] = None        # linked from last forward
+        self.batch_size: int = 0                   # linked: minibatch_size
+        self.err_output = Array()
+        self.loss = 0.0                            # mean loss, this minibatch
+        self._compiled = None
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.err_output.initialize(device)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.labels: Optional[Array] = None        # linked: minibatch_labels
+        self.n_err = 0
+        self.n_classes = kwargs.get("n_classes", 0)
+        self.confusion_matrix = Array()            # (pred, true) counts
+        self.max_err_output_sum = 0.0
+
+    @staticmethod
+    def compute(probs, labels, batch_size, n_classes):
+        """Pure metrics+cotangent computation (jit-compiled once)."""
+        import jax.numpy as jnp
+
+        n = probs.shape[0]
+        valid = (jnp.arange(n) < batch_size)
+        onehot = jnp.eye(n_classes, dtype=probs.dtype)[labels]
+        err = (probs - onehot) * valid[:, None] / jnp.maximum(batch_size, 1)
+        pred = jnp.argmax(probs, axis=-1)
+        wrong = (pred != labels) & valid
+        n_err = jnp.sum(wrong)
+        eps = jnp.finfo(probs.dtype).tiny
+        ce = -jnp.log(jnp.maximum(
+            jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0], eps))
+        loss = jnp.sum(jnp.where(valid, ce, 0.0)) / jnp.maximum(batch_size, 1)
+        conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+            pred, labels].add(valid.astype(jnp.int32))
+        max_err_sum = jnp.max(jnp.sum(jnp.abs(err), axis=-1))
+        return err, n_err, loss, conf, max_err_sum
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.n_classes:
+            self.n_classes = int(self.output.shape[-1])
+        self.confusion_matrix.mem = np.zeros(
+            (self.n_classes, self.n_classes), np.int32)
+        self.confusion_matrix.initialize(device)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.compute, static_argnums=(3,))
+        err, n_err, loss, conf, mes = self._compiled(
+            self.output.devmem, self.labels.devmem,
+            np.int32(self.batch_size), self.n_classes)
+        self.err_output.devmem = err
+        self.confusion_matrix.devmem = conf
+        self.n_err = int(n_err)
+        self.loss = float(loss)
+        self.max_err_output_sum = float(mes)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.target: Optional[Array] = None        # linked: minibatch_targets
+        self.mse = Array()                         # per-sample mse
+        #: optional: with labels linked, also report argmin-distance n_err
+        self.labels = None
+        self.n_err = 0
+
+    @staticmethod
+    def compute(output, target, batch_size):
+        import jax.numpy as jnp
+
+        n = output.shape[0]
+        y = output.reshape(n, -1)
+        t = target.reshape(n, -1)
+        valid = (jnp.arange(n) < batch_size)
+        diff = (y - t) * valid[:, None]
+        err = diff / jnp.maximum(batch_size, 1)
+        se = jnp.sum(jnp.square(diff), axis=-1)    # per-sample ||y-t||^2
+        loss = 0.5 * jnp.sum(se) / jnp.maximum(batch_size, 1)
+        return err.reshape(output.shape), se, loss
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.compute)
+        err, mse, loss = self._compiled(
+            self.output.devmem, self.target.devmem, np.int32(self.batch_size))
+        self.err_output.devmem = err
+        self.mse.devmem = mse
+        self.loss = float(loss)

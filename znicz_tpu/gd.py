@@ -1,0 +1,55 @@
+"""Fully-connected backward units (rebuild of ``znicz/gd.py``).
+
+``GradientDescent`` (linear), ``GDTanh``, ``GDRELU``, ``GDStrictRELU``,
+``GDSigmoid``, ``GDSoftmax``.  Each is the vjp of its forward twin (see
+nn_units.GradientDescentBase); ``GDSoftmax`` takes the vjp of the *linear*
+part only because the evaluator's ``err_output = softmax - target`` is
+already the cross-entropy cotangent at the logits (the reference's fused
+softmax+CE backward kernel did exactly this).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import GradientDescentBase
+from znicz_tpu.ops.linear import linear
+
+
+class GradientDescent(GradientDescentBase):
+    """Backward for any All2All* via vjp of forward.apply."""
+
+
+class GDTanh(GradientDescent):
+    pass
+
+
+class GDRELU(GradientDescent):
+    pass
+
+
+class GDStrictRELU(GradientDescent):
+    pass
+
+
+class GDSigmoid(GradientDescent):
+    pass
+
+
+class GDSoftmax(GradientDescent):
+    """err_output is d(CE)/d(logits): bypass the softmax in the vjp."""
+
+    def backward_apply(self, params, x):
+        fwd = self.forward
+        y = linear(x, params["weights"], params.get("bias"),
+                   weights_transposed=fwd.weights_transposed)
+        return y.reshape((x.shape[0],) + fwd.output_sample_shape)
+
+
+#: forward-class-name -> GD class (StandardWorkflow uses this).
+GD_BY_FORWARD = {
+    "All2All": GradientDescent,
+    "All2AllTanh": GDTanh,
+    "All2AllRELU": GDRELU,
+    "All2AllStrictRELU": GDStrictRELU,
+    "All2AllSigmoid": GDSigmoid,
+    "All2AllSoftmax": GDSoftmax,
+}

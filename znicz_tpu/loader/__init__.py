@@ -1,0 +1,5 @@
+from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN  # noqa: F401
+from znicz_tpu.loader.fullbatch import (  # noqa: F401
+    FullBatchLoader,
+    FullBatchLoaderMSE,
+)

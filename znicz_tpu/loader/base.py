@@ -1,0 +1,155 @@
+"""Loader base: the epoch/minibatch state machine (rebuild of
+``veles/loader/base.py``, SURVEY.md §2.1 "Loader base").
+
+Reference semantics preserved:
+  - three sample classes TEST=0, VALID=1, TRAIN=2 with ``class_lengths``;
+  - one epoch = one full pass over test, then valid, then train;
+  - minibatches never straddle class boundaries; the tail minibatch of a
+    class is short (``minibatch_size < max_minibatch_size``) and consumers
+    mask by ``minibatch_size`` (the reference padded instead — same math,
+    masking is the jit-friendly form since buffer shapes stay static);
+  - only the TRAIN segment is reshuffled, once per epoch, from the seeded
+    "loader" PRNG stream;
+  - ``last_minibatch`` marks the end of an epoch, ``class_ended`` the end of
+    a class segment; ``epoch_number`` increments when the next epoch begins.
+
+Subclasses implement ``load_data()`` (set class_lengths, allocate) and
+``fill_minibatch()`` (write minibatch_data/labels for ``minibatch_indices``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+from znicz_tpu.memory import Array
+
+TEST, VALID, TRAIN = 0, 1, 2
+
+
+class Loader(Unit):
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.max_minibatch_size = int(kwargs.get("minibatch_size", 100))
+        self.shuffle = kwargs.get("shuffle", True)
+        self.class_lengths: List[int] = [0, 0, 0]
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.minibatch_size = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.last_minibatch = False
+        self.class_ended = False
+        self.epoch_number = 0
+        self.epoch_ended = False
+        self._shuffled_indices: Optional[np.ndarray] = None
+        self._pos = 0
+        self.samples_served = 0
+
+    # -- derived geometry -----------------------------------------------------
+
+    @property
+    def total_samples(self) -> int:
+        return int(sum(self.class_lengths))
+
+    @property
+    def class_end_offsets(self) -> List[int]:
+        ends, acc = [], 0
+        for n in self.class_lengths:
+            acc += n
+            ends.append(acc)
+        return ends
+
+    def class_of_offset(self, offset: int) -> int:
+        for klass, end in enumerate(self.class_end_offsets):
+            if offset < end:
+                return klass
+        raise ValueError(f"offset {offset} out of range")
+
+    # -- subclass API ---------------------------------------------------------
+
+    def load_data(self) -> None:
+        """Set class_lengths and prepare storage.  Subclasses override."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self) -> None:
+        """Allocate minibatch buffers (called once, after load_data)."""
+        raise NotImplementedError
+
+    def fill_minibatch(self) -> None:
+        """Fill minibatch_data/labels for the current minibatch_indices
+        (first ``minibatch_size`` entries valid)."""
+        raise NotImplementedError
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError(f"{self.name}: empty dataset")
+        if self.class_lengths[TRAIN] == 0:
+            raise ValueError(f"{self.name}: no TRAIN samples")
+        self._shuffled_indices = np.arange(self.total_samples, dtype=np.int32)
+        self.create_minibatch_data()
+        idx = np.zeros(self.max_minibatch_size, np.int32)
+        self.minibatch_indices.mem = idx
+        for arr in (self.minibatch_data, self.minibatch_labels,
+                    self.minibatch_indices):
+            arr.initialize(device)
+        self._shuffle_train()
+
+    def _shuffle_train(self) -> None:
+        if not self.shuffle:
+            return
+        start = self.class_end_offsets[VALID]
+        seg = self._shuffled_indices[start:]
+        perm = prng.get("loader").permutation(len(seg))
+        self._shuffled_indices[start:] = seg[perm]
+
+    def reset(self) -> None:
+        """Restart from epoch 0 (used by tests and the genetics driver);
+        clears every state field __init__ sets."""
+        self._pos = 0
+        self.epoch_number = 0
+        self.last_minibatch = False
+        self.epoch_ended = False
+        self.class_ended = False
+        self.minibatch_size = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.samples_served = 0
+        self._shuffled_indices = np.arange(self.total_samples, dtype=np.int32)
+        self._shuffle_train()
+
+    # -- the state machine ----------------------------------------------------
+
+    def run(self):
+        if self.last_minibatch:
+            # previous run served the epoch tail -> begin the next epoch
+            self._pos = 0
+            self.epoch_number += 1
+            self.last_minibatch = False
+            self._shuffle_train()
+        self.epoch_ended = False
+        klass = self.class_of_offset(self._pos)
+        class_end = self.class_end_offsets[klass]
+        end = min(self._pos + self.max_minibatch_size, class_end)
+        count = end - self._pos
+        idx = self.minibatch_indices.map_invalidate()
+        chunk = self._shuffled_indices[self._pos:end]
+        idx[:count] = chunk
+        idx[count:] = chunk[-1] if count else 0   # pad with a valid index
+        self.minibatch_size = count
+        self.minibatch_class = klass
+        self.minibatch_offset = self._pos
+        self.class_ended = (end == class_end)
+        self.last_minibatch = (end == self.total_samples)
+        self.epoch_ended = self.last_minibatch
+        self._pos = end
+        self.samples_served += count
+        self.fill_minibatch()

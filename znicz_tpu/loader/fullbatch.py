@@ -1,0 +1,105 @@
+"""FullBatchLoader: whole dataset resident, minibatches sliced by gather
+(rebuild of ``veles/loader/fullbatch.py``).
+
+TPU-native change: the reference kept the full batch in device memory and ran
+a "copy minibatch" kernel; here the dataset lives in HBM as one jax array and
+``fill_minibatch`` is a jitted ``jnp.take`` gather — no host↔device traffic
+in the steady state (SURVEY.md guidance: minimise transfers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.loader.base import Loader
+from znicz_tpu.memory import Array
+
+
+class FullBatchLoader(Loader):
+    """Subclasses (or callers) provide the full dataset via ``original_data``
+    / ``original_labels`` (numpy, sample-major) before initialize, or
+    override ``load_data`` to fill them."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.original_data = Array()
+        self.original_labels = Array()
+        self.normalizer = kwargs.get("normalizer")
+        self._gather = None
+
+    def load_data(self) -> None:
+        if self.original_data.mem is None:
+            raise ValueError(f"{self.name}: original_data not set")
+        if sum(self.class_lengths) == 0:
+            # default: everything is TRAIN
+            self.class_lengths = [0, 0, len(self.original_data)]
+        if self.normalizer is not None:
+            data = self.original_data.map_write()
+            train_start = self.class_end_offsets[1]
+            self.normalizer.fit(data[train_start:])
+            self.normalizer.apply_inplace(data)
+
+    def create_minibatch_data(self) -> None:
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.mem = np.zeros(
+            (self.max_minibatch_size,) + tuple(sample_shape), np.float32)
+        if self.original_labels.mem is not None:
+            self.minibatch_labels.mem = np.zeros(
+                self.max_minibatch_size,
+                self.original_labels.mem.dtype)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.original_data.initialize(device)
+        self.original_labels.initialize(device)
+
+    def fill_minibatch(self) -> None:
+        if self._gather is None:
+            import jax
+
+            self._gather = jax.jit(
+                lambda data, idx: jax.numpy.take(data, idx, axis=0))
+        idx = self.minibatch_indices.devmem
+        self.minibatch_data.devmem = self._gather(
+            self.original_data.devmem, idx)
+        if self.original_labels.mem is not None:
+            self.minibatch_labels.devmem = self._gather(
+                self.original_labels.devmem, idx)
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Adds per-sample regression targets (``original_targets``); for
+    autoencoders targets default to the input data itself."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.original_targets = Array()
+        self.minibatch_targets = Array()
+        self.targets_from_data = kwargs.get("targets_from_data", False)
+
+    def load_data(self) -> None:
+        super().load_data()
+        if self.original_targets.mem is None:
+            if not self.targets_from_data:
+                raise ValueError(
+                    f"{self.name}: original_targets not set "
+                    "(pass targets_from_data=True for autoencoders)")
+            self.original_targets.mem = self.original_data.mem
+
+    def create_minibatch_data(self) -> None:
+        super().create_minibatch_data()
+        self.minibatch_targets.mem = np.zeros(
+            (self.max_minibatch_size,) + tuple(self.original_targets.shape[1:]),
+            np.float32)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.original_targets.initialize(device)
+        self.minibatch_targets.initialize(device)
+
+    def fill_minibatch(self) -> None:
+        super().fill_minibatch()
+        self.minibatch_targets.devmem = self._gather(
+            self.original_targets.devmem, self.minibatch_indices.devmem)

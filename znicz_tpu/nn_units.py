@@ -1,0 +1,246 @@
+"""NN unit bases (rebuild of ``znicz/nn_units.py``, SURVEY.md §2.2 "NN base").
+
+Two base classes:
+
+  - ``ForwardBase`` — a unit with ``input -> output`` plus learnable params
+    (weights/bias), weight init policies (uniform/gaussian ``weights_stddev``),
+    ``weights_transposed``, and a pure ``apply(params, x)`` the whole stack
+    reuses (unit-at-a-time run, fused train step, numpy oracle tests).
+
+  - ``GradientDescentBase`` — the reference's hand-written backward ("GD")
+    units become a facade over ``jax.vjp`` of the paired forward's pure
+    function.  What is preserved is the *semantics* the reference exposed:
+    per-unit learning_rate / learning_rate_bias / weights_decay / l1_vs_l2 /
+    gradient_moment (momentum) / gradient clipping, err_output -> err_input
+    chaining in reverse unit order, and updates applied only on TRAIN
+    minibatches.  What is gone: hand-derived derivative kernels (vjp cannot
+    drift from the forward math).
+
+TPU notes: each unit jits one step function with static shapes; parameters
+and hyperparameters are traced arguments so per-epoch lr adjustment never
+recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+from znicz_tpu.memory import Array
+
+
+class ForwardBase(Unit):
+    """Base of every forward compute unit.
+
+    Config kwargs (reference names):
+      - ``weights_stddev``: init scale; default ``1/sqrt(fan_in)``-style.
+      - ``weights_filling``: "uniform" | "gaussian" | "constant".
+      - ``bias_stddev`` / ``bias_filling``: same for bias.
+      - ``include_bias``: bias term on/off.
+      - ``weights_transposed``: store W as (in, out) instead of (out, in).
+    """
+
+    #: subclasses with no learnable params set this False (pooling, dropout…)
+    has_weights = True
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.input: Optional[Array] = None
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+        self.weights_stddev = kwargs.get("weights_stddev")
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.bias_stddev = kwargs.get("bias_stddev")
+        self.bias_filling = kwargs.get("bias_filling", "constant")
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_transposed = kwargs.get("weights_transposed", False)
+        self._compiled = None
+
+    # -- weight init ---------------------------------------------------------
+
+    def _fill(self, arr: np.ndarray, filling: str, stddev: float) -> None:
+        gen = prng.get(self.name)
+        if filling == "uniform":
+            # The reference's uniform filling spans ±stddev·sqrt(3) so that
+            # the std matches the gaussian filling.
+            lim = stddev * np.sqrt(3.0)
+            gen.fill_uniform(arr, -lim, lim)
+        elif filling == "gaussian":
+            gen.fill_normal(arr, stddev)
+        elif filling == "constant":
+            arr[...] = stddev
+        else:
+            raise ValueError(f"unknown filling {filling!r}")
+
+    def init_weights(self, w_shape: Tuple[int, ...],
+                     b_shape: Tuple[int, ...]) -> None:
+        fan_in = int(np.prod(w_shape[1:])) or 1
+        stddev = self.weights_stddev or 1.0 / np.sqrt(fan_in)
+        w = np.zeros(w_shape, np.float32)
+        self._fill(w, self.weights_filling, stddev)
+        if self.weights_transposed:
+            w = np.ascontiguousarray(w.T)
+        self.weights.mem = w
+        if self.include_bias:
+            b = np.zeros(b_shape, np.float32)
+            self._fill(b, self.bias_filling, self.bias_stddev or 0.0)
+            self.bias.mem = b
+
+    # -- pure compute --------------------------------------------------------
+
+    def params(self) -> Dict[str, Array]:
+        """name -> Array of learnable params (used by GD twin, snapshotter,
+        fused trainer)."""
+        if not self.has_weights:
+            return {}
+        out = {"weights": self.weights}
+        if self.include_bias:
+            out["bias"] = self.bias
+        return out
+
+    def apply(self, params: Dict, x):
+        """Pure forward: params dict of jax arrays + input -> output.
+        Subclasses MUST override.  No side effects, jit-safe."""
+        raise NotImplementedError
+
+    def output_shape_for(self, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Static output shape given input shape; subclasses override."""
+        raise NotImplementedError
+
+    # -- unit lifecycle ------------------------------------------------------
+
+    def create_output(self) -> None:
+        shape = self.output_shape_for(tuple(self.input.shape))
+        if self.output.mem is None or tuple(self.output.shape) != shape:
+            self.output.mem = np.zeros(shape, np.float32)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        for arr in (self.weights, self.bias, self.output):
+            arr.initialize(device)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.apply)
+        p = {k: a.devmem for k, a in self.params().items()}
+        self.output.devmem = self._compiled(p, self.input.devmem)
+
+
+def _decay_grad(w, weights_decay, l1_vs_l2):
+    """Regularization gradient, reference formula: a weighted mix of L2 (w)
+    and L1 (sign w) — ``factor_l1·sign(w)/2 + factor_l2·w`` with
+    ``l1_vs_l2`` interpolating."""
+    import jax.numpy as jnp
+
+    return weights_decay * (l1_vs_l2 * 0.5 * jnp.sign(w)
+                            + (1.0 - l1_vs_l2) * w)
+
+
+class GradientDescentBase(Unit):
+    """Backward twin of a ``ForwardBase``: consumes ``err_output``, produces
+    ``err_input`` and updates the forward's params in place (on device).
+
+    Hyperparameters (reference names / defaults):
+      learning_rate (0.01), learning_rate_bias (= learning_rate),
+      weights_decay (0.0), weights_decay_bias (0.0), l1_vs_l2 (0.0 = pure L2),
+      gradient_moment (0.0), gradient_moment_bias (= gradient_moment),
+      gradient_clip (0 = off; max-abs clip of raw gradients).
+    """
+
+    def __init__(self, workflow=None, name=None, forward: ForwardBase = None,
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.forward = forward
+        self.err_output: Optional[Array] = None     # linked from downstream
+        self.err_input = Array()                     # produced for upstream
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get("learning_rate_bias",
+                                             self.learning_rate)
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.l1_vs_l2 = kwargs.get("l1_vs_l2", 0.0)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get("gradient_moment_bias",
+                                               self.gradient_moment)
+        self.gradient_clip = kwargs.get("gradient_clip", 0.0)
+        #: when False, compute err_input but skip the param update (the
+        #: reference's ``apply_gradient`` switch; also off for frozen layers)
+        self.apply_gradient = kwargs.get("apply_gradient", True)
+        #: first GD in the chain doesn't need err_input (reference's
+        #: ``need_err_input``)
+        self.need_err_input = kwargs.get("need_err_input", True)
+        self._velocities: Dict[str, Array] = {}
+        self._compiled = None
+
+    # -- pure compute --------------------------------------------------------
+
+    def backward_apply(self, params: Dict, x):
+        """The function whose vjp defines this unit's backward.  Defaults to
+        the forward's ``apply``; softmax GD overrides (CE+softmax combo makes
+        err_output already the logits cotangent)."""
+        return self.forward.apply(params, x)
+
+    def _step(self, params, velocities, x, err_output, hypers):
+        """Pure: one backward+update step.  Returns (err_input, new_params,
+        new_velocities)."""
+        import jax
+        import jax.numpy as jnp
+
+        (lr, lr_bias, wd, wd_bias, l1l2, mom, mom_bias, clip) = hypers
+        _, vjp = jax.vjp(self.backward_apply, params, x)
+        grads, err_input = vjp(err_output)
+        new_params, new_vel = {}, {}
+        for k, g in grads.items():
+            w = params[k]
+            is_bias = (k == "bias")
+            k_lr = lr_bias if is_bias else lr
+            k_wd = wd_bias if is_bias else wd
+            k_mom = mom_bias if is_bias else mom
+            g = jnp.where(clip > 0.0, jnp.clip(g, -clip, clip), g)
+            g = g + _decay_grad(w, k_wd, l1l2)
+            v = k_mom * velocities[k] - k_lr * g
+            new_vel[k] = v
+            new_params[k] = w + v
+        return err_input, new_params, new_vel
+
+    # -- unit lifecycle ------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        assert self.forward is not None, f"{self.name}: no forward twin"
+        for k, arr in self.forward.params().items():
+            vel = Array(np.zeros(arr.shape, np.float32))
+            vel.initialize(device)
+            self._velocities[k] = vel
+        self.err_input.initialize(device)
+
+    def _hypers(self):
+        import numpy as np
+
+        return tuple(np.float32(v) for v in (
+            self.learning_rate, self.learning_rate_bias, self.weights_decay,
+            self.weights_decay_bias, self.l1_vs_l2, self.gradient_moment,
+            self.gradient_moment_bias, self.gradient_clip))
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self._step)
+        params = {k: a.devmem for k, a in self.forward.params().items()}
+        vels = {k: a.devmem for k, a in self._velocities.items()}
+        err_in, new_params, new_vels = self._compiled(
+            params, vels, self.forward.input.devmem, self.err_output.devmem,
+            self._hypers())
+        if self.need_err_input:
+            self.err_input.devmem = err_in
+        if self.apply_gradient:
+            for k, arr in self.forward.params().items():
+                arr.devmem = new_params[k]
+            for k, arr in self._velocities.items():
+                arr.devmem = new_vels[k]

@@ -1,0 +1,19 @@
+"""Pure functional op library — the rebuild's replacement for the reference's
+hand-written OpenCL/CUDA kernels (SURVEY.md §2.3).
+
+Every op is a pure jax function of explicit arrays, usable three ways:
+  1. wrapped by a Unit's per-step jitted ``run`` (unit-at-a-time mode),
+  2. composed into one fused jitted train step (StandardWorkflow fast path),
+  3. called with numpy inputs for golden-value tests (jax-on-cpu == oracle).
+"""
+
+from znicz_tpu.ops.activations import (  # noqa: F401
+    ACTIVATIONS,
+    relu_log,
+    sigmoid,
+    sincos,
+    softmax,
+    strict_relu,
+    tanh_scaled,
+)
+from znicz_tpu.ops.linear import linear  # noqa: F401

@@ -1,0 +1,77 @@
+"""Activation functions with the reference's exact constants.
+
+The reference's elementwise kernels (SURVEY.md §2.3) implement:
+
+  - ``tanh``        — LeCun's scaled tanh ``1.7159 * tanh(2/3 x)`` (the
+    constants that make unit outputs have ~unit variance at init);
+  - ``RELU``        — the *soft* relu ``log(1 + e^x)`` (reference's "RELU");
+  - ``StrictRELU``  — ``max(0, x)`` (what everyone else calls relu);
+  - ``sigmoid``     — logistic;
+  - ``log``         — ``log(x + sqrt(x^2 + 1))`` (asinh-style);
+  - ``sincos``      — alternating sin/cos by element parity;
+  - ``mul``         — elementwise product with a second operand (used by
+    gating constructions).
+
+Derivatives are NOT hand-written here: backward units take ``jax.vjp`` of
+these functions, so constants can never drift between fwd and bwd.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# LeCun tanh constants (reference kernels hard-code these).
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+
+def tanh_scaled(x):
+    return TANH_A * jnp.tanh(TANH_B * x)
+
+
+def relu_log(x):
+    """The reference's "RELU": softplus ``log(1 + e^x)`` (numerically safe)."""
+    return jax.nn.softplus(x)
+
+
+def strict_relu(x):
+    return jnp.maximum(x, 0.0)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_act(x):
+    return jnp.log(x + jnp.sqrt(jnp.square(x) + 1.0))
+
+
+def sincos(x):
+    """Even elements -> sin, odd -> cos (reference's SinCos unit)."""
+    flat = x.reshape(-1)
+    idx = jnp.arange(flat.shape[0])
+    out = jnp.where(idx % 2 == 0, jnp.sin(flat), jnp.cos(flat))
+    return out.reshape(x.shape)
+
+
+def softmax(x):
+    """Row softmax with the max-subtraction the reference kernel did."""
+    return jax.nn.softmax(x, axis=-1)
+
+
+def identity(x):
+    return x
+
+
+#: name -> fn registry used by StandardWorkflow layer configs.
+ACTIVATIONS = {
+    "linear": identity,
+    "tanh": tanh_scaled,
+    "relu": relu_log,
+    "strict_relu": strict_relu,
+    "sigmoid": sigmoid,
+    "log": log_act,
+    "sincos": sincos,
+    "softmax": softmax,
+}

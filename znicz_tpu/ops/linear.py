@@ -1,0 +1,28 @@
+"""Dense (fully-connected) op — the reference's clBLAS/cuBLAS GEMM path
+(SURVEY.md §2.3 row "GEMM") becomes one ``jnp.dot`` that XLA lowers onto the
+MXU.  bfloat16 matmul with float32 accumulation is the TPU-native precision
+policy; params stay float32.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def linear(x, w, b=None, *, weights_transposed: bool = False,
+           compute_dtype=None):
+    """``y = x @ W^T + b`` over flattened trailing dims.
+
+    The reference stored weights as (out, in) and ran GEMM with transpose
+    flags (``weights_transposed`` flips storage to (in, out) — kept for
+    parity with its config surface).
+    """
+    x2 = x.reshape(x.shape[0], -1)
+    if compute_dtype is not None:
+        x2 = x2.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    y = jnp.dot(x2, w if weights_transposed else w.T,
+                preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b
+    return y

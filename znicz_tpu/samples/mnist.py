@@ -1,0 +1,151 @@
+"""MNIST sample: 784 -> 100(tanh) -> 10(softmax) MLP — the rebuild of the
+reference's ``znicz/samples/MNIST`` workflow, BASELINE config[0].
+
+Wiring mirrors the reference call stack (SURVEY.md §3.1):
+
+    start -> repeater -> loader -> fwd1 -> fwd2 -> evaluator -> decision
+                ^                                                 |
+                |            (gd_skip gates on non-TRAIN)         v
+                +------------- gd1 <---------- gd2 <--------------+
+    decision.complete -> end_point (gate_block otherwise)
+    decision.improved & epoch_ended -> snapshotter
+
+Data: procedural digit glyphs (datasets.digits) unless
+``root.mnist.loader.data_path`` points at an .npz with real MNIST.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu import datasets
+from znicz_tpu.all2all import All2AllSoftmax, All2AllTanh
+from znicz_tpu.core.config import root
+from znicz_tpu.core.workflow import Repeater, Workflow
+from znicz_tpu.decision import DecisionGD
+from znicz_tpu.evaluator import EvaluatorSoftmax
+from znicz_tpu.gd import GDSoftmax, GDTanh
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.snapshotter import Snapshotter
+
+# -- defaults (override like the reference: root.mnist.decision.max_epochs=3;
+#    overrides set before import win, exactly like reference config files)
+root.mnist.defaults({
+    "loader": {"minibatch_size": 60, "n_train": 4000, "n_valid": 800,
+               "n_test": 0, "data_path": ""},
+    "layers": [100, 10],
+    "learning_rate": 0.1,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0,
+    "decision": {"max_epochs": 5, "fail_iterations": 0},
+    "snapshotter": {"prefix": "mnist", "interval": 0},
+})
+
+
+class MnistLoader(FullBatchLoader):
+    def load_data(self):
+        cfg = root.mnist.loader
+        n_train = int(cfg.get("n_train", 4000))
+        n_valid = int(cfg.get("n_valid", 800))
+        n_test = int(cfg.get("n_test", 0))
+        total = n_train + n_valid + n_test
+        data, labels = datasets.load_or_generate(
+            cfg.get("data_path") or None, datasets.digits, total)
+        # order: [test | valid | train] to match class offsets
+        self.original_data.mem = data.reshape(total, -1)
+        self.original_labels.mem = labels
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+
+class MnistWorkflow(Workflow):
+    def __init__(self, **kwargs):
+        super().__init__(name="MnistWorkflow", **kwargs)
+        cfg = root.mnist
+        layers = list(cfg.get("layers"))
+        lr = float(cfg.get("learning_rate"))
+        mom = float(cfg.get("gradient_moment"))
+        wd = float(cfg.get("weights_decay"))
+
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = MnistLoader(
+            self, name="loader",
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        self.loader.link_from(self.repeater)
+
+        # forwards
+        self.forwards = []
+        prev = self.loader
+        prev_attr = "minibatch_data"
+        for i, width in enumerate(layers):
+            cls = All2AllSoftmax if i == len(layers) - 1 else All2AllTanh
+            fwd = cls(self, name=f"fwd{i}", output_sample_shape=(width,))
+            fwd.link_from(prev if i == 0 else self.forwards[-1])
+            fwd.link_attrs(prev, ("input", prev_attr))
+            self.forwards.append(fwd)
+            prev, prev_attr = fwd, "output"
+
+        self.evaluator = EvaluatorSoftmax(self, name="evaluator",
+                                          n_classes=layers[-1])
+        self.evaluator.link_from(self.forwards[-1])
+        self.evaluator.link_attrs(self.forwards[-1], "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("labels", "minibatch_labels"),
+                                  ("batch_size", "minibatch_size"))
+
+        self.decision = DecisionGD(
+            self, name="decision",
+            max_epochs=int(cfg.decision.get("max_epochs")),
+            fail_iterations=int(cfg.decision.get("fail_iterations")))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch", "class_ended",
+            "epoch_number", "class_lengths", "minibatch_size")
+        self.decision.link_attrs(
+            self.evaluator, ("minibatch_loss", "loss"),
+            ("minibatch_n_err", "n_err"), "confusion_matrix",
+            "max_err_output_sum")
+
+        self.snapshotter = Snapshotter(
+            self, name="snapshotter",
+            prefix=cfg.snapshotter.get("prefix"),
+            interval=int(cfg.snapshotter.get("interval", 0)))
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(self.decision, "epoch_number")
+        self.snapshotter.improved = self.decision.improved   # shared Bool
+        self.snapshotter.gate_skip = ~self.decision.epoch_ended
+
+        # backward chain, reverse order
+        self.gds = []
+        err_src, err_attr = self.evaluator, "err_output"
+        for i in reversed(range(len(layers))):
+            cls = GDSoftmax if i == len(layers) - 1 else GDTanh
+            gd = cls(self, name=f"gd{i}", forward=self.forwards[i],
+                     learning_rate=lr, gradient_moment=mom, weights_decay=wd,
+                     need_err_input=(i > 0))
+            gd.link_from(self.snapshotter if not self.gds else self.gds[-1])
+            gd.link_attrs(err_src, ("err_output", err_attr))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds.append(gd)
+            err_src, err_attr = gd, "err_input"
+
+        self.repeater.link_from(self.gds[-1])
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(snapshot: str = "", device=None) -> MnistWorkflow:
+    wf = MnistWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    wf.run()
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,151 @@
+"""Snapshotter: periodic + best-on-validation checkpointing (rebuild of
+``veles/snapshotter.py``, SURVEY.md §3.5 / §5 "Checkpoint / resume").
+
+Format change from the reference (documented): the reference pickled the
+*entire workflow object graph* (code-coupled, fragile).  Here a snapshot is a
+plain dict of numpy arrays + JSON-able metadata, gzip-pickled:
+
+  {"config": {...}, "units": {unit_name: {param: ndarray}},
+   "velocities": {gd_name: {param: ndarray}}, "loader": {...},
+   "decision": {...}, "prng": {...}, "epoch": N, "metric": x}
+
+Resume rebuilds the workflow from config and calls ``restore(workflow,
+snapshot)`` — the reference's ``--snapshot`` CLI flag maps to the launcher's
+``snapshot=`` argument.  Best-on-validation trigger semantics preserved: the
+unit is gated on ``decision.improved & decision.epoch_ended``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.units import Unit
+
+
+def collect(workflow) -> Dict:
+    """Gather a snapshot dict from a workflow's units."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.decision import DecisionBase
+    from znicz_tpu.loader.base import Loader
+    from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+    snap: Dict = {"units": {}, "velocities": {}, "loader": {},
+                  "decision": {}, "prng": {}, "time": time.time()}
+    for unit in workflow:
+        if isinstance(unit, ForwardBase) and unit.has_weights:
+            snap["units"][unit.name] = {
+                k: np.array(a.map_read())
+                for k, a in unit.params().items()}
+        elif isinstance(unit, GradientDescentBase):
+            snap["velocities"][unit.name] = {
+                k: np.array(a.map_read())
+                for k, a in unit._velocities.items()}
+        elif isinstance(unit, Loader):
+            snap["loader"] = {
+                "epoch_number": unit.epoch_number,
+                "samples_served": unit.samples_served,
+            }
+            norm = getattr(unit, "normalizer", None)
+            if norm is not None:
+                snap["loader"]["normalizer"] = norm.state()
+        elif isinstance(unit, DecisionBase):
+            snap["decision"] = {
+                "best_metric": unit.best_metric,
+                "best_epoch": unit.best_epoch,
+                "fails": unit._fails,
+            }
+            snap["epoch"] = int(unit.epoch_number)
+            snap["metric"] = float(unit.best_metric)
+    snap["prng"] = {name: s.state.bit_generator.state
+                    for name, s in prng._streams.items()}
+    return snap
+
+
+def restore(workflow, snap: Dict) -> None:
+    """Apply a snapshot dict onto an initialized workflow (in place)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.decision import DecisionBase
+    from znicz_tpu.loader.base import Loader
+    from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+    for unit in workflow:
+        if isinstance(unit, ForwardBase) and unit.name in snap["units"]:
+            for k, a in unit.params().items():
+                a.mem = snap["units"][unit.name][k].copy()
+        elif isinstance(unit, GradientDescentBase) and \
+                unit.name in snap.get("velocities", {}):
+            for k, a in unit._velocities.items():
+                a.mem = snap["velocities"][unit.name][k].copy()
+        elif isinstance(unit, Loader) and snap.get("loader"):
+            unit.epoch_number = snap["loader"]["epoch_number"]
+            unit.samples_served = snap["loader"].get("samples_served", 0)
+            norm = getattr(unit, "normalizer", None)
+            if norm is not None and "normalizer" in snap["loader"]:
+                norm.restore(snap["loader"]["normalizer"])
+        elif isinstance(unit, DecisionBase) and snap.get("decision"):
+            unit.best_metric = snap["decision"]["best_metric"]
+            unit.best_epoch = snap["decision"]["best_epoch"]
+            unit._fails = snap["decision"]["fails"]
+    for name, state in snap.get("prng", {}).items():
+        stream = prng.get(name)
+        stream.state.bit_generator.state = state
+
+
+class Snapshotter(Unit):
+    """Writes snapshots at epoch boundaries.  Wire its gate to
+    ``decision.epoch_ended`` and link ``improved`` / ``epoch_number`` from
+    the decision; then:
+
+      - validation improved        -> saves ``<prefix>_best``
+      - every ``interval`` epochs  -> saves ``<prefix>_epoch_<N>`` (0 = off)
+    """
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.prefix = kwargs.get("prefix", "wf")
+        self.directory = kwargs.get(
+            "directory", root.common.dirs.get("snapshots", "snapshots"))
+        self.interval = int(kwargs.get("interval", 0))   # 0 = best-only
+        self.compression = kwargs.get("compression", "gz")
+        self.destination: Optional[str] = None            # last written path
+        self.improved = False                             # link from decision
+        self.epoch_number = 0                             # link from decision
+        self._last_saved_epoch = -1
+
+    def snapshot_path(self, tag: str) -> str:
+        ext = ".pickle.gz" if self.compression == "gz" else ".pickle"
+        return os.path.join(self.directory, f"{self.prefix}_{tag}{ext}")
+
+    def save(self, tag: str) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        snap = collect(self.workflow)
+        snap["config"] = root.to_dict()
+        path = self.snapshot_path(tag)
+        opener = gzip.open if self.compression == "gz" else open
+        with opener(path, "wb") as f:
+            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self.destination = path
+        self.info("snapshot -> %s", path)
+        return path
+
+    def run(self):
+        if bool(self.improved):
+            self.save("best")
+        epoch = int(self.epoch_number)
+        if (self.interval and epoch != self._last_saved_epoch and
+                (epoch + 1) % self.interval == 0):
+            self.save(f"epoch_{epoch}")
+            self._last_saved_epoch = epoch
+
+    @staticmethod
+    def load(path: str) -> Dict:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            return pickle.load(f)
