@@ -1,0 +1,49 @@
+"""CIFAR sample e2e (BASELINE config[1] gate): StandardWorkflow declarative
+build trains the 3-conv+2-fc net and beats chance comfortably."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+
+@pytest.fixture
+def small_cifar(tmp_path):
+    root.cifar.loader.n_train = 300
+    root.cifar.loader.n_valid = 100
+    root.cifar.loader.n_test = 0
+    root.cifar.loader.minibatch_size = 50
+    root.cifar.decision.max_epochs = 8
+    root.common.dirs.snapshots = str(tmp_path)
+    yield
+
+
+def test_cifar_trains(small_cifar):
+    from znicz_tpu.samples import cifar
+
+    wf = cifar.run()
+    dec = wf.decision
+    assert bool(dec.complete)
+    valid = dec.epoch_metrics[1]
+    assert valid is not None
+    # 10-class chance = 90% err; textures are easy for convs
+    assert valid["err_pct"] < 55.0, valid
+
+
+def test_cifar_graph_shapes(small_cifar):
+    from znicz_tpu.samples import cifar
+
+    wf = cifar.CifarWorkflow()
+    wf.initialize(device=None)
+    shapes = [tuple(f.output.shape) for f in wf.forwards]
+    assert shapes[0] == (50, 32, 32, 16)      # conv 5x5 pad 2
+    assert shapes[1] == (50, 16, 16, 16)      # max pool 2x2
+    assert shapes[2] == (50, 16, 16, 16)      # LRN
+    assert shapes[4] == (50, 8, 8, 32)        # avg pool
+    assert shapes[6] == (50, 4, 4, 32)        # avg pool
+    assert shapes[7] == (50, 64)              # fc tanh
+    assert shapes[8] == (50, 10)              # softmax
+    # every trainable layer got a GD twin in reverse order
+    assert len(wf.gds) == len(wf.forwards)
+    assert wf.gds[0].forward is wf.forwards[-1]
+    assert wf.gds[-1].forward is wf.forwards[0]

@@ -1,0 +1,96 @@
+"""Conv unit numerics vs a pure-numpy direct convolution oracle + grad check."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.conv import Conv, ConvRELU, ConvStrictRELU, ConvTanh
+from znicz_tpu.gd_conv import GD_BY_FORWARD_CONV
+from znicz_tpu.memory import Array
+
+
+def np_conv(x, w, b, sliding, padding):
+    """Direct NHWC conv oracle. w: (K, ky, kx, C)."""
+    left, top, right, bottom = padding
+    sy, sx = sliding
+    xb = np.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
+    B, H, W, C = xb.shape
+    K, ky, kx, _ = w.shape
+    oh = (H - ky) // sy + 1
+    ow = (W - kx) // sx + 1
+    y = np.zeros((B, oh, ow, K), np.float32)
+    for oy in range(oh):
+        for ox in range(ow):
+            patch = xb[:, oy * sy:oy * sy + ky, ox * sx:ox * sx + kx, :]
+            y[:, oy, ox, :] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return y + b
+
+
+@pytest.mark.parametrize("sliding,padding", [
+    ((1, 1), (0, 0, 0, 0)),
+    ((2, 2), (1, 1, 1, 1)),
+    ((1, 2), (2, 1, 0, 3)),
+])
+def test_conv_matches_numpy(sliding, padding):
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(2, 8, 9, 3)).astype(np.float32)
+    fwd = Conv(name=f"c{sliding}{padding}", n_kernels=4, kx=3, ky=3,
+               sliding=sliding, padding=padding)
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    want = np_conv(x, fwd.weights.mem, fwd.bias.mem, sliding, padding)
+    got = np.array(fwd.output.map_read())
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_activations():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 5, 5, 2)).astype(np.float32)
+    for cls, act in [(ConvTanh, lambda v: 1.7159 * np.tanh(0.6666 * v)),
+                     (ConvRELU, lambda v: np.log1p(np.exp(v))),
+                     (ConvStrictRELU, lambda v: np.maximum(v, 0))]:
+        fwd = cls(name=f"ca_{cls.__name__}", n_kernels=3, kx=3, ky=3)
+        fwd.input = Array(x)
+        fwd.initialize(device=None)
+        fwd.run()
+        lin = np_conv(x, fwd.weights.mem, fwd.bias.mem, (1, 1), (0, 0, 0, 0))
+        np.testing.assert_allclose(np.array(fwd.output.map_read()), act(lin),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_gd_conv_finite_differences():
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+    fwd = ConvTanh(name="gcf", n_kernels=3, kx=3, ky=3, sliding=(1, 1),
+                   padding=(1, 1, 1, 1))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    w0 = fwd.weights.mem.copy()
+    b0 = fwd.bias.mem.copy()
+    fwd.run()
+    err = rng.normal(size=fwd.output.shape).astype(np.float32)
+    gd = GD_BY_FORWARD_CONV["ConvTanh"](
+        name="gcfgd", forward=fwd, learning_rate=1.0, gradient_moment=0.0)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    gd.run()
+    dW = w0 - np.array(fwd.weights.map_read())
+    err_input = np.array(gd.err_input.map_read())
+
+    def loss(w, xx):
+        lin = np_conv(xx, w, b0, (1, 1), (1, 1, 1, 1))
+        return float(np.sum(err * 1.7159 * np.tanh(0.6666 * lin)))
+
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0), (2, 1, 2, 1), (1, 2, 0, 1)]:
+        wp = w0.copy(); wp[idx] += eps
+        wm = w0.copy(); wm[idx] -= eps
+        num = (loss(wp, x) - loss(wm, x)) / (2 * eps)
+        assert abs(num - dW[idx]) < 5e-2 * max(1.0, abs(num)), idx
+    for idx in [(0, 0, 0, 0), (1, 3, 4, 1)]:
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        num = (loss(w0, xp) - loss(w0, xm)) / (2 * eps)
+        assert abs(num - err_input[idx]) < 5e-2 * max(1.0, abs(num)), idx

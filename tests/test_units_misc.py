@@ -1,0 +1,160 @@
+"""Activation units, dropout mask-reuse, LRN, Cutter."""
+
+import numpy as np
+
+from znicz_tpu.activation import (
+    BackwardTanh,
+    ForwardMul,
+    ForwardSinCos,
+    ForwardTanh,
+    ForwardTanhLog,
+)
+from znicz_tpu.cutter import Cutter, GDCutter
+from znicz_tpu.dropout import DropoutBackward, DropoutForward
+from znicz_tpu.lrn import LRNormalizerBackward, LRNormalizerForward
+from znicz_tpu.memory import Array
+
+
+def test_activation_tanh_fwd_bwd():
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    fwd = ForwardTanh(name="at")
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    want = 1.7159 * np.tanh(0.6666 * x)
+    np.testing.assert_allclose(np.array(fwd.output.map_read()), want,
+                               rtol=1e-5)
+    err = rng.normal(size=x.shape).astype(np.float32)
+    bwd = BackwardTanh(name="atb", forward=fwd)
+    bwd.err_output = Array(err)
+    bwd.initialize(device=None)
+    bwd.run()
+    deriv = 1.7159 * 0.6666 * (1 - np.tanh(0.6666 * x) ** 2)
+    np.testing.assert_allclose(np.array(bwd.err_input.map_read()),
+                               err * deriv, rtol=1e-4, atol=1e-5)
+
+
+def test_sincos_alternates():
+    x = np.linspace(-1, 1, 8).astype(np.float32).reshape(2, 4)
+    fwd = ForwardSinCos(name="sc")
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    got = np.array(fwd.output.map_read()).reshape(-1)
+    flat = x.reshape(-1)
+    for i in range(8):
+        want = np.sin(flat[i]) if i % 2 == 0 else np.cos(flat[i])
+        assert abs(got[i] - want) < 1e-6
+
+
+def test_tanhlog_tail():
+    x = np.array([[0.5, 20.0, -20.0]], np.float32)
+    fwd = ForwardTanhLog(name="tl")
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    got = np.array(fwd.output.map_read())[0]
+    assert abs(got[0] - 1.7159 * np.tanh(0.6666 * 0.5)) < 1e-5
+    assert abs(got[1] - (1.7159 + np.log(11.0))) < 1e-4
+    assert abs(got[2] + (1.7159 + np.log(11.0))) < 1e-4
+
+
+def test_mul_unit():
+    a = np.full((2, 3), 2.0, np.float32)
+    b = np.full((2, 3), 4.0, np.float32)
+    fwd = ForwardMul(name="mul")
+    fwd.input = Array(a)
+    fwd.x2 = Array(b)
+    fwd.initialize(device=None)
+    fwd.run()
+    np.testing.assert_allclose(np.array(fwd.output.map_read()), a * b)
+
+
+def test_dropout_train_mask_reuse_eval_identity():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(20, 30)).astype(np.float32)
+    fwd = DropoutForward(name="do", dropout_ratio=0.4)
+    fwd.input = Array(x)
+    fwd.minibatch_class = 2                    # TRAIN
+    fwd.initialize(device=None)
+    fwd.run()
+    y = np.array(fwd.output.map_read())
+    m = np.array(fwd.mask.map_read())
+    np.testing.assert_allclose(y, x * m, rtol=1e-6)
+    keep = (m > 0).mean()
+    assert 0.4 < keep < 0.8                    # ~0.6 keep-prob
+    np.testing.assert_allclose(m[m > 0], 1.0 / 0.6, rtol=1e-5)
+
+    err = rng.normal(size=x.shape).astype(np.float32)
+    bwd = DropoutBackward(name="dob", forward=fwd)
+    bwd.err_output = Array(err)
+    bwd.initialize(device=None)
+    bwd.run()
+    np.testing.assert_allclose(np.array(bwd.err_input.map_read()), err * m,
+                               rtol=1e-6)
+
+    fwd.minibatch_class = 1                    # VALID: identity
+    fwd.run()
+    np.testing.assert_allclose(np.array(fwd.output.map_read()), x)
+    bwd.run()
+    np.testing.assert_allclose(np.array(bwd.err_input.map_read()), err)
+
+
+def test_lrn_matches_numpy():
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(2, 3, 3, 8)).astype(np.float32)
+    fwd = LRNormalizerForward(name="lrn")
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    alpha, beta, n, k = 1e-4, 0.75, 5, 2.0
+    want = np.zeros_like(x)
+    C = 8
+    for c in range(C):
+        lo, hi = max(0, c - n // 2), min(C, c + n // 2 + 1)
+        s = np.sum(np.square(x[..., lo:hi]), axis=-1)
+        want[..., c] = x[..., c] / (k + alpha * s) ** beta
+    np.testing.assert_allclose(np.array(fwd.output.map_read()), want,
+                               rtol=1e-5, atol=1e-6)
+    # backward: finite-difference spot check
+    err = rng.normal(size=x.shape).astype(np.float32)
+    bwd = LRNormalizerBackward(name="lrnb", forward=fwd)
+    bwd.err_output = Array(err)
+    bwd.initialize(device=None)
+    bwd.run()
+    got = np.array(bwd.err_input.map_read())
+
+    def loss(xx):
+        out = np.zeros_like(xx)
+        for c in range(C):
+            lo, hi = max(0, c - n // 2), min(C, c + n // 2 + 1)
+            s = np.sum(np.square(xx[..., lo:hi]), axis=-1)
+            out[..., c] = xx[..., c] / (k + alpha * s) ** beta
+        return float(np.sum(err * out))
+
+    eps = 1e-2
+    for idx in [(0, 0, 0, 0), (1, 2, 1, 5)]:
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        num = (loss(xp) - loss(xm)) / (2 * eps)
+        assert abs(num - got[idx]) < 5e-3 * max(1.0, abs(num)), idx
+
+
+def test_cutter_fwd_bwd():
+    x = np.arange(2 * 5 * 6 * 1, dtype=np.float32).reshape(2, 5, 6, 1)
+    fwd = Cutter(name="cut", padding=(1, 2, 1, 1))   # l, t, r, b
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    got = np.array(fwd.output.map_read())
+    np.testing.assert_allclose(got, x[:, 2:4, 1:5, :])
+    err = np.ones_like(got)
+    bwd = GDCutter(name="cutb", forward=fwd)
+    bwd.err_output = Array(err)
+    bwd.initialize(device=None)
+    bwd.run()
+    back = np.array(bwd.err_input.map_read())
+    assert back.shape == x.shape
+    assert back[:, 2:4, 1:5, :].sum() == err.sum()
+    assert back.sum() == err.sum()

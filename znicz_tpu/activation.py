@@ -1,0 +1,88 @@
+"""Standalone activation units fwd+bwd (rebuild of ``znicz/activation.py``).
+
+The reference shipped ``ActivationForward``/``ActivationBackward`` pairs for
+Tanh, Sigmoid, RELU (softplus), StrictRELU, Log, TanhLog, SinCos and Mul as
+separate graph units (used when an activation isn't fused into an
+All2All/Conv).  Backwards are vjps of the forward fn — no hand-derived
+derivative constants to drift (SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+from znicz_tpu.ops import activations
+
+
+class ActivationForward(ForwardBase):
+    has_weights = False
+    ACTIVATION = staticmethod(activations.identity)
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        return type(self).ACTIVATION(x)
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class ActivationBackward(GradientDescentBase):
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)
+
+
+def _make(name, fn):
+    fwd = type(f"Forward{name}", (ActivationForward,),
+               {"ACTIVATION": staticmethod(fn)})
+    bwd = type(f"Backward{name}", (ActivationBackward,), {})
+    return fwd, bwd
+
+
+ForwardTanh, BackwardTanh = _make("Tanh", activations.tanh_scaled)
+ForwardSigmoid, BackwardSigmoid = _make("Sigmoid", activations.sigmoid)
+ForwardRELU, BackwardRELU = _make("RELU", activations.relu_log)
+ForwardStrictRELU, BackwardStrictRELU = _make(
+    "StrictRELU", activations.strict_relu)
+ForwardLog, BackwardLog = _make("Log", activations.log_act)
+ForwardSinCos, BackwardSinCos = _make("SinCos", activations.sincos)
+
+
+def _tanhlog(x):
+    """Reference's TanhLog: scaled tanh for |x| < 10, log-tail outside."""
+    import jax.numpy as jnp
+
+    t = activations.tanh_scaled(x)
+    tail = jnp.sign(x) * (activations.TANH_A +
+                          jnp.log(jnp.maximum(jnp.abs(x) - 9.0, 1.0)))
+    return jnp.where(jnp.abs(x) < 10.0, t, tail)
+
+
+ForwardTanhLog, BackwardTanhLog = _make("TanhLog", _tanhlog)
+
+
+class ForwardMul(ForwardBase):
+    """Elementwise product with a second linked input ``x2`` (the
+    reference's Mul gate)."""
+
+    has_weights = False
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        raise NotImplementedError("ForwardMul consumes two inputs; use run()")
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(lambda a, b: a * b)
+        self.output.devmem = self._compiled(self.input.devmem,
+                                            self.x2.devmem)

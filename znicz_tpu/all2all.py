@@ -72,18 +72,8 @@ class All2AllSigmoid(All2All):
 
 class All2AllSoftmax(All2All):
     """Output is the softmax distribution itself (reference semantics); the
-    paired GDSoftmax treats err_output as the logits cotangent."""
+    paired GDSoftmax treats err_output as the logits cotangent.  (The
+    reference also exported a ``max_idx`` argmax buffer; here the evaluator
+    computes argmax inside its own jitted metrics step instead.)"""
 
     ACTIVATION = staticmethod(activations.softmax)
-
-    def __init__(self, workflow=None, name=None, output_sample_shape=(),
-                 **kwargs):
-        super().__init__(workflow=workflow, name=name,
-                         output_sample_shape=output_sample_shape, **kwargs)
-        from znicz_tpu.memory import Array
-        self.max_idx = Array()
-
-    def run(self):
-        super().run()
-        import jax.numpy as jnp
-        self.max_idx.devmem = jnp.argmax(self.output.devmem, axis=-1)

@@ -1,0 +1,92 @@
+"""Convolution forward units (rebuild of ``znicz/conv.py``).
+
+Reference surface preserved: ``n_kernels``, ``kx``/``ky``, ``sliding``
+(stride), 4-sided ``padding`` (left, top, right, bottom), fused activation
+variants (``ConvTanh``, ``ConvRELU`` = softplus, ``ConvStrictRELU``).
+
+TPU-native execution: the reference's hand-tiled OCL/CUDA direct-conv kernels
+(SURVEY.md §2.3) become one ``lax.conv_general_dilated`` in NHWC — XLA lowers
+it onto the MXU; no im2col staging buffer exists because XLA fuses it.
+Weights are stored ``(n_kernels, ky, kx, channels)`` like the reference's
+flattened filter rows.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from znicz_tpu.nn_units import ForwardBase
+from znicz_tpu.ops import activations
+
+
+def conv_output_hw(h: int, w: int, ky: int, kx: int,
+                   sliding: Tuple[int, int],
+                   padding: Tuple[int, int, int, int]) -> Tuple[int, int]:
+    left, top, right, bottom = padding
+    sy, sx = sliding
+    return ((h + top + bottom - ky) // sy + 1,
+            (w + left + right - kx) // sx + 1)
+
+
+class Conv(ForwardBase):
+    ACTIVATION = staticmethod(activations.identity)
+
+    def __init__(self, workflow=None, name=None, n_kernels=8, kx=3, ky=3,
+                 sliding=(1, 1), padding=(0, 0, 0, 0), **kwargs):
+        if kwargs.get("weights_transposed"):
+            raise ValueError("weights_transposed is an All2All storage "
+                             "option; Conv weights are always (K, ky, kx, C)")
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky)
+        self.sliding = tuple(sliding)
+        self.padding = tuple(padding)      # (left, top, right, bottom)
+
+    def output_shape_for(self, in_shape):
+        b, h, w, c = in_shape
+        oh, ow = conv_output_hw(h, w, self.ky, self.kx, self.sliding,
+                                self.padding)
+        return (b, oh, ow, self.n_kernels)
+
+    def apply(self, params, x):
+        import jax.lax as lax
+
+        w = params["weights"]                       # (K, ky, kx, C)
+        left, top, right, bottom = self.padding
+        y = lax.conv_general_dilated(
+            x, jnp_transpose_hwio(w),
+            window_strides=self.sliding,
+            padding=((top, bottom), (left, right)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=np.float32)
+        if self.include_bias:
+            y = y + params["bias"]
+        return type(self).ACTIVATION(y)
+
+    def initialize(self, device=None, **kwargs):
+        b, h, w, c = self.input.shape
+        if self.weights.mem is None:
+            self.init_weights((self.n_kernels, self.ky, self.kx, int(c)),
+                              (self.n_kernels,))
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+def jnp_transpose_hwio(w):
+    """(K, ky, kx, C) -> (ky, kx, C, K) for lax conv HWIO."""
+    return w.transpose(1, 2, 3, 0)
+
+
+class ConvTanh(Conv):
+    ACTIVATION = staticmethod(activations.tanh_scaled)
+
+
+class ConvRELU(Conv):
+    ACTIVATION = staticmethod(activations.relu_log)
+
+
+class ConvStrictRELU(Conv):
+    ACTIVATION = staticmethod(activations.strict_relu)

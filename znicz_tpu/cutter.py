@@ -1,0 +1,38 @@
+"""Cutter: crop a region of the input plane, fwd+bwd (rebuild of
+``znicz/cutter.py``).  Padding kwargs follow the reference: the crop keeps
+``[top:H-bottom, left:W-right]`` of an NHWC tensor; the backward pads
+err_output back with zeros (vjp of a static slice)."""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+
+class Cutter(ForwardBase):
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, padding=(0, 0, 0, 0),
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.padding = tuple(padding)       # (left, top, right, bottom)
+
+    def output_shape_for(self, in_shape):
+        b, h, w, c = in_shape
+        left, top, right, bottom = self.padding
+        return (b, h - top - bottom, w - left - right, c)
+
+    def apply(self, params, x):
+        left, top, right, bottom = self.padding
+        h, w = x.shape[1], x.shape[2]
+        return x[:, top:h - bottom, left:w - right, :]
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class GDCutter(GradientDescentBase):
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)

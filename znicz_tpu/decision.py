@@ -23,8 +23,8 @@ import numpy as np
 
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TEST, TRAIN, VALID
 
-TEST, VALID, TRAIN = 0, 1, 2
 CLASS_NAMES = ("test", "valid", "train")
 
 

@@ -1,0 +1,85 @@
+"""Dropout fwd+bwd (rebuild of ``znicz/dropout.py``).
+
+``DropoutForward`` samples an inverted-scale Bernoulli mask on TRAIN
+minibatches (keep-prob ``1 - dropout_ratio``, survivors scaled by
+``1/(1-ratio)``), is the identity on TEST/VALID, and *stores the mask*;
+``DropoutBackward`` multiplies err_output by that same mask (SURVEY.md §7
+hard part 4: mask reuse between fwd and bwd, never resampled).  Device RNG
+is the seeded per-unit jax key stream (documented divergence from the
+reference's xorshift kernels — parity is distributional).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Array
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+
+class DropoutForward(ForwardBase):
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, dropout_ratio=0.5, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+        self.mask = Array()
+        self.minibatch_class = TRAIN               # link from loader
+        self._step_counter = 0
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        # Fused-trainer path uses sample_mask() explicitly; unit-at-a-time
+        # identity here is the eval path.
+        return x
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        self.mask.initialize(device)
+        super().initialize(device=device, **kwargs)
+
+    @staticmethod
+    def make_mask(key, shape, ratio):
+        import jax
+
+        keep = 1.0 - ratio
+        return jax.random.bernoulli(key, keep, shape).astype("float32") / keep
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+
+            def train_step(x, key):
+                m = self.make_mask(key, x.shape, self.dropout_ratio)
+                return x * m, m
+
+            self._compiled = jax.jit(train_step)
+        if int(self.minibatch_class) == TRAIN:
+            key = prng.get(self.name).jax_key(self._step_counter)
+            self._step_counter += 1
+            y, m = self._compiled(self.input.devmem, key)
+            self.output.devmem = y
+            self.mask.devmem = m
+        else:
+            self.output.devmem = self.input.devmem
+            self.mask.reset(None)
+
+
+class DropoutBackward(GradientDescentBase):
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(lambda e, m: e * m)
+        mask = self.forward.mask
+        if mask:                                    # TRAIN: mask stored
+            self.err_input.devmem = self._compiled(self.err_output.devmem,
+                                                   mask.devmem)
+        else:                                       # eval: identity
+            self.err_input.devmem = self.err_output.devmem

@@ -99,10 +99,15 @@ class EvaluatorMSE(EvaluatorBase):
     def __init__(self, workflow=None, name=None, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.target: Optional[Array] = None        # linked: minibatch_targets
-        self.mse = Array()                         # per-sample mse
-        #: optional: with labels linked, also report argmin-distance n_err
+        self.mse = Array()                         # per-sample ||y-t||^2
+        #: optional classification-through-regression mode (the reference's
+        #: EvaluatorMSE + class_targets): link ``labels`` AND set
+        #: ``class_targets`` (n_classes, *sample_shape); n_err counts samples
+        #: whose nearest class target (L2) disagrees with the label.
         self.labels = None
+        self.class_targets = Array()
         self.n_err = 0
+        self._compiled_nerr = None
 
     @staticmethod
     def compute(output, target, batch_size):
@@ -118,6 +123,17 @@ class EvaluatorMSE(EvaluatorBase):
         loss = 0.5 * jnp.sum(se) / jnp.maximum(batch_size, 1)
         return err.reshape(output.shape), se, loss
 
+    @staticmethod
+    def compute_n_err(output, class_targets, labels, batch_size):
+        import jax.numpy as jnp
+
+        n = output.shape[0]
+        y = output.reshape(n, 1, -1)
+        ct = class_targets.reshape(1, class_targets.shape[0], -1)
+        pred = jnp.argmin(jnp.sum(jnp.square(y - ct), axis=-1), axis=-1)
+        valid = (jnp.arange(n) < batch_size)
+        return jnp.sum((pred != labels) & valid)
+
     def run(self):
         if self._compiled is None:
             import jax
@@ -127,3 +143,10 @@ class EvaluatorMSE(EvaluatorBase):
         self.err_output.devmem = err
         self.mse.devmem = mse
         self.loss = float(loss)
+        if self.labels is not None and self.class_targets:
+            if self._compiled_nerr is None:
+                import jax
+                self._compiled_nerr = jax.jit(self.compute_n_err)
+            self.n_err = int(self._compiled_nerr(
+                self.output.devmem, self.class_targets.devmem,
+                self.labels.devmem, np.int32(self.batch_size)))

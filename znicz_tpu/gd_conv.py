@@ -1,0 +1,36 @@
+"""Convolution backward units (rebuild of ``znicz/gd_conv.py``).
+
+The reference hand-wrote transposed-correlation kernels for err_input and
+patch-matmul kernels for dW; here both are exactly what ``jax.vjp`` of the
+forward conv emits (XLA's conv-transpose forms), so these classes only fix
+the naming/type surface.  ``GDConvSoftmax`` does not exist in the reference
+(conv is never the top layer feeding CE directly).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import GradientDescentBase
+
+
+class GradientDescentConv(GradientDescentBase):
+    pass
+
+
+class GDTanhConv(GradientDescentConv):
+    pass
+
+
+class GDRELUConv(GradientDescentConv):
+    pass
+
+
+class GDStrictRELUConv(GradientDescentConv):
+    pass
+
+
+GD_BY_FORWARD_CONV = {
+    "Conv": GradientDescentConv,
+    "ConvTanh": GDTanhConv,
+    "ConvRELU": GDRELUConv,
+    "ConvStrictRELU": GDStrictRELUConv,
+}

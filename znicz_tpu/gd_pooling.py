@@ -1,0 +1,79 @@
+"""Pooling backward units (rebuild of ``znicz/gd_pooling.py``).
+
+``GDMaxPooling`` / ``GDMaxAbsPooling`` (and the stochastic twins) route
+err_output to the input positions *recorded by the forward* (the reference's
+offset arrays) via a scatter-add; ``GDAvgPooling`` is the vjp of the forward
+average (uniform spread over each window's real elements).  Pooling has no
+params, so these GDs only produce err_input.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.nn_units import GradientDescentBase
+
+
+class GDPooling(GradientDescentBase):
+    """Base: no params; err_input only."""
+
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)
+
+
+class GDAvgPooling(GDPooling):
+    """vjp of the forward average — uniform spread / real-element count."""
+
+
+class GDMaxPoolingBase(GDPooling):
+    """Scatter err_output to the forward-recorded offsets."""
+
+    def _scatter(self, err_output, offsets):
+        import jax.numpy as jnp
+
+        fwd = self.forward
+        b, h, w, c, oh, ow, sy, sx, ph, pw = fwd._window_geometry()
+        kx = fwd.kx
+        oy = np.arange(oh)[None, :, None, None]
+        ox = np.arange(ow)[None, None, :, None]
+        ay = oy * sy + offsets // kx               # absolute row per output
+        ax = ox * sx + offsets % kx
+        bidx = jnp.arange(b)[:, None, None, None]
+        cidx = jnp.arange(c)[None, None, None, :]
+        padded = jnp.zeros((b, ph, pw, c), err_output.dtype)
+        padded = padded.at[bidx, ay, ax, cidx].add(err_output)
+        return padded[:, :h, :w, :]
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self._scatter)
+        self.err_input.devmem = self._compiled(
+            self.err_output.devmem, self.forward.input_offset.devmem)
+
+
+class GDMaxPooling(GDMaxPoolingBase):
+    pass
+
+
+class GDMaxAbsPooling(GDMaxPoolingBase):
+    pass
+
+
+class GDStochasticPooling(GDMaxPoolingBase):
+    pass
+
+
+class GDStochasticAbsPooling(GDMaxPoolingBase):
+    pass
+
+
+GD_BY_FORWARD_POOLING = {
+    "MaxPooling": GDMaxPooling,
+    "MaxAbsPooling": GDMaxAbsPooling,
+    "AvgPooling": GDAvgPooling,
+    "StochasticPooling": GDStochasticPooling,
+    "StochasticAbsPooling": GDStochasticAbsPooling,
+}

@@ -1,0 +1,50 @@
+"""Local response normalization fwd+bwd (rebuild of ``znicz/normalization.py``
+— the AlexNet-style across-channel LRN; the input-data normalizers live in
+``znicz_tpu/normalization.py`` matching the reference's core-vs-znicz split).
+
+Forward: ``y = x / (k + alpha * sum_{j in window(c)} x_j^2) ^ beta`` with the
+window of ``n`` adjacent channels centered on c.  Backward is the vjp.
+Defaults follow the reference kernels: alpha=1e-4, beta=0.75, n=5, k=2.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+
+class LRNormalizerForward(ForwardBase):
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, alpha=1e-4, beta=0.75,
+                 n=5, k=2.0, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.n = int(n)
+        self.k = float(k)
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+
+        half = self.n // 2
+        sq = jnp.square(x)
+        # sum over a window of n adjacent channels (zero-padded at the ends)
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        acc = jnp.zeros_like(x)
+        for j in range(self.n):                      # n is tiny & static
+            acc = acc + padded[..., j:j + x.shape[-1]]
+        return x / jnp.power(self.k + self.alpha * acc, self.beta)
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class LRNormalizerBackward(GradientDescentBase):
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)

@@ -1,0 +1,97 @@
+"""CIFAR10 sample: 3-conv + 2-fc convnet — rebuild of the reference's
+``znicz/samples/CIFAR10`` workflow, BASELINE config[1].  Declarative build
+via StandardWorkflow; data is the procedural 32x32x3 texture set unless
+``root.cifar.loader.data_path`` points at a real .npz.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+root.cifar.defaults({
+    "loader": {"minibatch_size": 100, "n_train": 2000, "n_valid": 400,
+               "n_test": 0, "data_path": ""},
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0001,
+    "decision": {"max_epochs": 12, "fail_iterations": 0},
+    "snapshotter": {"prefix": "cifar", "interval": 0},
+})
+
+
+class CifarLoader(FullBatchLoader):
+    def load_data(self):
+        cfg = root.cifar.loader
+        n_train = int(cfg.get("n_train"))
+        n_valid = int(cfg.get("n_valid"))
+        n_test = int(cfg.get("n_test"))
+        total = n_train + n_valid + n_test
+        data, labels = datasets.load_or_generate(
+            cfg.get("data_path") or None, datasets.tinyimages, total)
+        self.original_data.mem = data                # NHWC
+        self.original_labels.mem = labels
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+
+def make_layers():
+    cfg = root.cifar
+    gd = {"learning_rate": float(cfg.get("learning_rate")),
+          "gradient_moment": float(cfg.get("gradient_moment")),
+          "weights_decay": float(cfg.get("weights_decay"))}
+    return [
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 16, "kx": 5, "ky": 5, "padding": (2, 2, 2, 2)},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "norm"},
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": (2, 2, 2, 2)},
+         "<-": dict(gd)},
+        {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": (2, 2, 2, 2)},
+         "<-": dict(gd)},
+        {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 64},
+         "<-": dict(gd)},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": dict(gd)},
+    ]
+
+
+class CifarWorkflow(StandardWorkflow):
+    def __init__(self, **kwargs):
+        cfg = root.cifar
+        loader = CifarLoader(
+            name="loader",
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        super().__init__(
+            name="CifarWorkflow", loader=loader, layers=make_layers(),
+            loss_function="softmax",
+            decision_config={
+                "max_epochs": int(cfg.decision.get("max_epochs")),
+                "fail_iterations": int(cfg.decision.get("fail_iterations"))},
+            snapshotter_config={
+                "prefix": cfg.snapshotter.get("prefix"),
+                "interval": int(cfg.snapshotter.get("interval", 0))},
+            **kwargs)
+
+
+def run(snapshot: str = "", device=None) -> CifarWorkflow:
+    wf = CifarWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        from znicz_tpu.snapshotter import Snapshotter
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    wf.run()
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()
