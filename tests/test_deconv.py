@@ -1,0 +1,139 @@
+"""Deconv/Depooling numerics: adjoint properties + the MnistAE e2e gate
+(BASELINE config[2])."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.conv import Conv
+from znicz_tpu.core.config import root
+from znicz_tpu.deconv import Deconv
+from znicz_tpu.depooling import Depooling, GDDepooling
+from znicz_tpu.gd_deconv import GDDeconv
+from znicz_tpu.memory import Array
+from znicz_tpu.pooling import MaxPooling
+
+
+def test_deconv_is_conv_adjoint():
+    """<conv(x), y> == <x, deconv(y)> for all x, y (exact adjoint)."""
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(2, 6, 6, 3)).astype(np.float32)
+    conv = Conv(name="adc", n_kernels=4, kx=3, ky=3, sliding=(2, 2),
+                padding=(1, 1, 1, 1), include_bias=False)
+    conv.input = Array(x)
+    conv.initialize(device=None)
+    conv.run()
+    cy = np.array(conv.output.map_read())
+
+    dec = Deconv(name="add", weights_from=conv)
+    y = rng.normal(size=cy.shape).astype(np.float32)
+    dec.input = Array(y)
+    dec.output_shape_from = conv.input
+    dec.initialize(device=None)
+    dec.run()
+    dx = np.array(dec.output.map_read())
+    assert dx.shape == x.shape
+    np.testing.assert_allclose(np.sum(cy * y), np.sum(x * dx), rtol=1e-4)
+
+
+def test_deconv_own_weights_shape_inference():
+    rng = np.random.default_rng(18)
+    y = rng.normal(size=(1, 3, 3, 4)).astype(np.float32)
+    dec = Deconv(name="own", n_kernels=4, kx=2, ky=2, sliding=(2, 2))
+    dec.input = Array(y)
+    dec.initialize(device=None)
+    assert dec.weights.shape == (4, 2, 2, 1)
+    dec.run()
+    assert tuple(dec.output.shape) == (1, 6, 6, 1)
+
+
+def test_gd_deconv_finite_differences():
+    rng = np.random.default_rng(19)
+    x = rng.normal(size=(1, 3, 3, 2)).astype(np.float32)
+    dec = Deconv(name="gdd", n_kernels=2, kx=2, ky=2, sliding=(2, 2),
+                 output_sample_shape=(6, 6, 1))
+    dec.input = Array(x)
+    dec.initialize(device=None)
+    w0 = dec.weights.mem.copy()
+    dec.run()
+    err = rng.normal(size=dec.output.shape).astype(np.float32)
+    gd = GDDeconv(name="gddgd", forward=dec, learning_rate=1.0,
+                  need_err_input=True)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    gd.run()
+    dW = w0 - np.array(dec.weights.map_read())
+
+    import jax
+    import jax.numpy as jnp
+
+    def loss(w):
+        y = dec.apply({"weights": jnp.asarray(w)}, jnp.asarray(x))
+        return float(jnp.sum(jnp.asarray(err) * y))
+
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0), (1, 1, 1, 0)]:
+        wp = w0.copy(); wp[idx] += eps
+        wm = w0.copy(); wm[idx] -= eps
+        num = (loss(wp) - loss(wm)) / (2 * eps)
+        assert abs(num - dW[idx]) < 5e-2 * max(1.0, abs(num)), idx
+
+
+def test_depooling_scatters_to_pool_offsets():
+    rng = np.random.default_rng(20)
+    x = rng.normal(size=(1, 4, 4, 1)).astype(np.float32)
+    pool = MaxPooling(name="dpp", kx=2, ky=2)
+    pool.input = Array(x)
+    pool.initialize(device=None)
+    pool.run()
+    v = rng.normal(size=(1, 2, 2, 1)).astype(np.float32)
+    dep = Depooling(name="dpu", pooling_from=pool)
+    dep.input = Array(v)
+    dep.initialize(device=None)
+    dep.run()
+    up = np.array(dep.output.map_read())
+    assert up.shape == x.shape
+    # each value lands exactly at its window's argmax
+    off = np.array(pool.input_offset.map_read())
+    want = np.zeros_like(x)
+    for oy in range(2):
+        for ox in range(2):
+            dy, dx = divmod(int(off[0, oy, ox, 0]), 2)
+            want[0, oy * 2 + dy, ox * 2 + dx, 0] = v[0, oy, ox, 0]
+    np.testing.assert_allclose(up, want)
+    # GD gathers back: adjoint round-trip
+    gd = GDDepooling(name="dpgd", forward=dep)
+    err = rng.normal(size=x.shape).astype(np.float32)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    gd.run()
+    got = np.array(gd.err_input.map_read())
+    for oy in range(2):
+        for ox in range(2):
+            dy, dx = divmod(int(off[0, oy, ox, 0]), 2)
+            assert got[0, oy, ox, 0] == err[0, oy * 2 + dy, ox * 2 + dx, 0]
+
+
+@pytest.fixture
+def small_ae(tmp_path):
+    root.mnist_ae.loader.n_train = 400
+    root.mnist_ae.loader.n_valid = 100
+    root.mnist_ae.loader.n_test = 0
+    root.mnist_ae.loader.minibatch_size = 50
+    root.mnist_ae.decision.max_epochs = 5
+    root.common.dirs.snapshots = str(tmp_path)
+    yield
+
+
+def test_mnist_ae_trains(small_ae):
+    from znicz_tpu.samples import mnist_ae
+
+    losses = []
+    wf = mnist_ae.MnistAEWorkflow()
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    wf.initialize(device=None)
+    wf.run()
+    assert bool(wf.decision.complete)
+    assert losses[-1] < losses[0] * 0.7, losses   # reconstruction improves
+    # tied weights: encoder and decoder share the same Array
+    assert wf.deconv.weights is wf.conv.weights

@@ -1,0 +1,117 @@
+"""Kohonen SOM + RBM units (BASELINE config[3] behavioral-parity gate)."""
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+from znicz_tpu.kohonen import KohonenForward, KohonenTrainer, grid_coords
+from znicz_tpu.memory import Array
+from znicz_tpu.rbm import Binarization, GradientRBM
+
+
+def test_kohonen_forward_winner_oracle():
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    fwd = KohonenForward(name="kf", shape=(3, 3))
+    fwd.input = Array(x)
+    fwd.initialize(device=None)
+    fwd.run()
+    w = fwd.weights.mem
+    want = np.argmin(((x[:, None, :] - w[None]) ** 2).sum(-1), axis=1)
+    got = np.array(fwd.output.map_read())
+    np.testing.assert_array_equal(got, want)
+    hits = np.array(fwd.hits.map_read())
+    assert hits.sum() == 6
+    assert fwd.total == 6
+
+
+def test_kohonen_trainer_moves_winner_toward_sample():
+    x = np.array([[1.0, 1.0]], np.float32)
+    tr = KohonenTrainer(name="kt", shape=(2, 2), learning_rate=0.5,
+                        radius=0.5, decay_epochs=1e9)
+    tr.input = Array(x)
+    tr.batch_size = 1
+    tr.initialize(device=None)
+    w0 = tr.weights.mem.copy()
+    d0 = ((w0 - x) ** 2).sum(1)
+    win = int(np.argmin(d0))
+    tr.run()
+    w1 = np.array(tr.weights.map_read())
+    d1 = ((w1 - x) ** 2).sum(1)
+    assert d1[win] < d0[win]          # winner moved toward the sample
+    assert tr.qerror > 0
+
+
+def test_kohonen_forward_masks_padded_tail():
+    """With batch_size < buffer rows, padded duplicates must not count."""
+    rng = np.random.default_rng(24)
+    x = rng.normal(size=(5, 3)).astype(np.float32)
+    fwd = KohonenForward(name="kfm", shape=(2, 2))
+    fwd.input = Array(x)
+    fwd.batch_size = 3
+    fwd.initialize(device=None)
+    fwd.run()
+    assert fwd.total == 3
+    assert np.array(fwd.hits.map_read()).sum() == 3
+
+
+def test_kohonen_grid_coords():
+    c = grid_coords(2, 3)
+    assert c.shape == (6, 2)
+    np.testing.assert_allclose(c[0], [0, 0])
+    np.testing.assert_allclose(c[-1], [1, 2])
+
+
+def test_kohonen_sample_organizes(tmp_path):
+    root.kohonen.loader.n_train = 300
+    root.kohonen.loader.minibatch_size = 50
+    root.kohonen.decision.max_epochs = 8
+    from znicz_tpu.samples import kohonen
+
+    wf = kohonen.run()
+    q = wf.decision.epoch_qerror
+    assert len(q) == 8
+    assert q[-1] < q[0] * 0.5, q       # quantization error halves
+    # hit map covers a decent fraction of the 8x8 grid
+    wf.forward.reset_hits()
+    wf.loader.reset()
+    for _ in range(6):
+        wf.loader.run()
+        wf.forward.run()
+    hits = np.array(wf.forward.hits.map_read())
+    assert hits.sum() == 300
+    assert (hits > 0).sum() >= 10      # winners spread over the map
+
+
+def test_binarization_bernoulli():
+    p = np.full((2000,), 0.3, np.float32).reshape(100, 20)
+    b = Binarization(name="bin")
+    b.input = Array(p)
+    b.initialize(device=None)
+    b.run()
+    out = np.array(b.output.map_read())
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    assert 0.25 < out.mean() < 0.35
+
+
+def test_rbm_cd1_reduces_reconstruction_error():
+    from znicz_tpu.all2all import All2AllSigmoid
+
+    rng = np.random.default_rng(29)
+    # two binary prototype patterns + noise
+    protos = (rng.random(size=(2, 16)) > 0.5).astype(np.float32)
+    data = protos[rng.integers(0, 2, size=64)]
+    flip = rng.random(size=data.shape) < 0.05
+    data = np.abs(data - flip.astype(np.float32))
+
+    hidden = All2AllSigmoid(name="rbm_h", output_sample_shape=(8,))
+    hidden.input = Array(data)
+    hidden.initialize(device=None)
+    gr = GradientRBM(name="rbm_gd", hidden=hidden, learning_rate=0.2)
+    gr.input = Array(data)
+    gr.batch_size = 64
+    gr.initialize(device=None)
+    errs = []
+    for _ in range(30):
+        gr.run()
+        errs.append(gr.reconstruction_error)
+    assert errs[-1] < errs[0] * 0.7, (errs[0], errs[-1])

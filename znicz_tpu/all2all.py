@@ -6,8 +6,9 @@ Reference classes (SURVEY.md §2.2 "Fully connected"): ``All2All`` (linear),
 plus a bias+activation kernel; here the whole thing is one jitted
 ``linear``+activation, which XLA fuses onto the MXU.
 
-``All2AllSoftmax`` additionally exports ``max_idx`` (argmax per sample) which
-the reference's evaluator consumed for n_err/confusion.
+``All2AllSoftmax``'s output is the probability distribution itself; argmax /
+n_err / confusion all happen inside the evaluator's jitted metrics step (the
+reference exported a separate ``max_idx`` buffer instead).
 """
 
 from __future__ import annotations

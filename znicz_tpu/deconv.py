@@ -1,0 +1,110 @@
+"""Deconvolution (transpose conv) forward unit (rebuild of
+``znicz/deconv.py``).
+
+The reference's Deconv is the exact adjoint of a Conv with the same
+geometry: it maps a (B, OH, OW, K) feature map back to the conv's input
+shape (B, H, W, C).  It is defined here literally as the vjp of the conv
+forward — under jit the unused primal is dead-code-eliminated and XLA emits
+the same transposed-conv HLO the hand-written reference kernels computed.
+
+Autoencoder weight tying (the reference's pattern): pass
+``weights_from=conv_unit`` to share the encoder's weight Array; GDDeconv
+then trains the shared tensor.  The target spatial shape comes from
+``output_shape_from`` (an Array — usually the paired conv's ``input``) or an
+explicit ``output_sample_shape=(H, W, C)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.memory import Array
+from znicz_tpu.nn_units import ForwardBase
+from znicz_tpu.ops import activations
+
+
+class Deconv(ForwardBase):
+    ACTIVATION = staticmethod(activations.identity)
+
+    def __init__(self, workflow=None, name=None, n_kernels=8, kx=3, ky=3,
+                 sliding=(1, 1), padding=(0, 0, 0, 0),
+                 output_sample_shape: Optional[Tuple[int, int, int]] = None,
+                 weights_from: Optional[ForwardBase] = None, **kwargs):
+        if kwargs.get("weights_transposed"):
+            raise ValueError("weights_transposed does not apply to Deconv")
+        if kwargs.get("include_bias"):
+            raise ValueError("Deconv has no bias term (reference parity); "
+                             "follow with an activation/bias unit if needed")
+        kwargs.setdefault("include_bias", False)
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky)
+        self.sliding = tuple(sliding)
+        self.padding = tuple(padding)
+        self.output_sample_shape = (tuple(output_sample_shape)
+                                    if output_sample_shape else None)
+        self.output_shape_from: Optional[Array] = None
+        if weights_from is not None:
+            self.weights = weights_from.weights    # shared Array object
+            self.n_kernels = weights_from.n_kernels
+            self.kx, self.ky = weights_from.kx, weights_from.ky
+            self.sliding = weights_from.sliding
+            self.padding = weights_from.padding
+
+    def _target_hwc(self) -> Tuple[int, int, int]:
+        if self.output_shape_from is not None:
+            _, h, w, c = self.output_shape_from.shape
+            return int(h), int(w), int(c)
+        if self.output_sample_shape is not None:
+            return self.output_sample_shape
+        # infer minimal cover: H = (OH-1)*sy + ky - pads
+        _, oh, ow, _ = self.input.shape
+        left, top, right, bottom = self.padding
+        sy, sx = self.sliding
+        c = self.weights.shape[3] if self.weights else 1
+        return ((oh - 1) * sy + self.ky - top - bottom,
+                (ow - 1) * sx + self.kx - left - right, int(c))
+
+    def output_shape_for(self, in_shape):
+        h, w, c = self._target_hwc()
+        return (in_shape[0], h, w, c)
+
+    def apply(self, params, x):
+        import jax
+        import jax.lax as lax
+
+        w = params["weights"]                       # (K, ky, kx, C)
+        h, wdt, c = self._target_hwc()
+        left, top, right, bottom = self.padding
+
+        def conv_fwd(ximg):
+            return lax.conv_general_dilated(
+                ximg, w.transpose(1, 2, 3, 0),
+                window_strides=self.sliding,
+                padding=((top, bottom), (left, right)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=np.float32)
+
+        zeros = jax.numpy.zeros((x.shape[0], h, wdt, c), x.dtype)
+        _, vjp = jax.vjp(conv_fwd, zeros)
+        y = vjp(x)[0]
+        return type(self).ACTIVATION(y)
+
+    def initialize(self, device=None, **kwargs):
+        if self.weights.mem is None:
+            h, w, c = self._target_hwc()
+            self.init_weights((self.n_kernels, self.ky, self.kx, c),
+                              (self.n_kernels,))
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class DeconvTanh(Deconv):
+    ACTIVATION = staticmethod(activations.tanh_scaled)
+
+
+class DeconvSigmoid(Deconv):
+    ACTIVATION = staticmethod(activations.sigmoid)

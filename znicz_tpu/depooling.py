@@ -1,0 +1,54 @@
+"""Depooling: inverse-pooling forward op for decoder stacks (rebuild of
+``znicz/depooling.py``).
+
+Routes each input value to the position its paired *pooling* unit selected
+on the current minibatch (``get_output_shape_from`` + offsets contract of
+the reference): construct with ``pooling_from=<MaxPooling unit>``; forward
+scatters through the recorded ``input_offset``; ``GDDepooling`` gathers back
+(the exact adjoint).  AvgPooling has no offsets — average depooling spreads
+uniformly (vjp of the average)."""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+
+class Depooling(ForwardBase):
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, pooling_from=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        assert pooling_from is not None, \
+            "Depooling needs pooling_from=<pooling unit>"
+        self.pooling = pooling_from
+
+    def output_shape_for(self, in_shape):
+        return tuple(self.pooling.input.shape)
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.pooling.scatter_at_offsets)
+        self.output.devmem = self._compiled(
+            self.input.devmem, self.pooling.input_offset.devmem)
+
+
+class GDDepooling(GradientDescentBase):
+    """Adjoint of Depooling: gather err_output at the recorded offsets
+    (shared geometry on PoolingBase.gather_at_offsets)."""
+
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        kwargs.setdefault("apply_gradient", False)
+        super().__init__(workflow=workflow, name=name, forward=forward,
+                         **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.forward.pooling.gather_at_offsets)
+        self.err_input.devmem = self._compiled(
+            self.err_output.devmem, self.forward.pooling.input_offset.devmem)

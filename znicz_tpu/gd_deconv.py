@@ -1,0 +1,21 @@
+"""Deconvolution backward unit (rebuild of ``znicz/gd_deconv.py``) — the vjp
+of Deconv.apply; because Deconv itself is a conv-vjp, the weight gradient and
+err_input XLA emits here are ordinary forward-conv forms (transpose of a
+transpose).  Works with tied weights: when the Deconv shares its weight Array
+with an encoder Conv, the update lands in the shared tensor."""
+
+from __future__ import annotations
+
+from znicz_tpu.nn_units import GradientDescentBase
+
+
+class GDDeconv(GradientDescentBase):
+    pass
+
+
+class GDDeconvTanh(GDDeconv):
+    pass
+
+
+class GDDeconvSigmoid(GDDeconv):
+    pass

@@ -9,8 +9,6 @@ params, so these GDs only produce err_input.
 
 from __future__ import annotations
 
-import numpy as np
-
 from znicz_tpu.nn_units import GradientDescentBase
 
 
@@ -28,28 +26,13 @@ class GDAvgPooling(GDPooling):
 
 
 class GDMaxPoolingBase(GDPooling):
-    """Scatter err_output to the forward-recorded offsets."""
-
-    def _scatter(self, err_output, offsets):
-        import jax.numpy as jnp
-
-        fwd = self.forward
-        b, h, w, c, oh, ow, sy, sx, ph, pw = fwd._window_geometry()
-        kx = fwd.kx
-        oy = np.arange(oh)[None, :, None, None]
-        ox = np.arange(ow)[None, None, :, None]
-        ay = oy * sy + offsets // kx               # absolute row per output
-        ax = ox * sx + offsets % kx
-        bidx = jnp.arange(b)[:, None, None, None]
-        cidx = jnp.arange(c)[None, None, None, :]
-        padded = jnp.zeros((b, ph, pw, c), err_output.dtype)
-        padded = padded.at[bidx, ay, ax, cidx].add(err_output)
-        return padded[:, :h, :w, :]
+    """Scatter err_output to the forward-recorded offsets (shared geometry
+    lives on PoolingBase.scatter_at_offsets)."""
 
     def run(self):
         if self._compiled is None:
             import jax
-            self._compiled = jax.jit(self._scatter)
+            self._compiled = jax.jit(self.forward.scatter_at_offsets)
         self.err_input.devmem = self._compiled(
             self.err_output.devmem, self.forward.input_offset.devmem)
 

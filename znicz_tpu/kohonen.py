@@ -1,0 +1,207 @@
+"""Kohonen self-organizing map units (rebuild of ``znicz/kohonen.py``,
+SURVEY.md §2.2 "Kohonen / SOM").
+
+The reference pair:
+
+  - ``KohonenForward`` — winner-take-all: per sample, the index of the
+    nearest neuron on an (sx, sy) grid (argmin L2); accumulates per-neuron
+    hit counts (the ``KohonenHits`` plot input).
+  - ``KohonenTrainer`` — unsupervised batch update with a gaussian
+    neighborhood whose radius and learning rate decay over time:
+        w += lr(t) · Σ_b gravity(i, winner_b; σ(t)) · (x_b − w_i) / B
+    No GD chain, no evaluator — the trainer IS the learning rule
+    (SURVEY.md §1: non-GD learner).
+
+TPU-native: one jitted step does distances (a single (B,N) matmul-style
+reduction on the MXU), argmin, neighborhood weighting and the batched
+outer-product update — the reference's four OCL kernels fused by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+from znicz_tpu.memory import Array
+from znicz_tpu.nn_units import ForwardBase
+
+
+def grid_coords(sy: int, sx: int) -> np.ndarray:
+    """(N, 2) float coords of the SOM grid, row-major."""
+    yy, xx = np.mgrid[0:sy, 0:sx]
+    return np.stack([yy.reshape(-1), xx.reshape(-1)], axis=1).astype(
+        np.float32)
+
+
+class KohonenBase:
+    @staticmethod
+    def distances(x, w):
+        """(B, N) squared L2 distances; expanded form runs the x·wᵀ term on
+        the MXU instead of materializing (B, N, D) diffs in HBM."""
+        import jax.numpy as jnp
+
+        x2 = jnp.sum(jnp.square(x), axis=1, keepdims=True)      # (B, 1)
+        w2 = jnp.sum(jnp.square(w), axis=1)[None, :]            # (1, N)
+        cross = x @ w.T                                          # MXU
+        return x2 + w2 - 2.0 * cross
+
+
+class KohonenForward(ForwardBase, KohonenBase):
+    """Winner indices + hit accumulation.  ``weights`` are linked from the
+    trainer (shared Array) or owned if standalone."""
+
+    def __init__(self, workflow=None, name=None, shape=(8, 8),
+                 weights_from: Optional[Unit] = None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.sy, self.sx = int(shape[0]), int(shape[1])
+        self.n_neurons = self.sy * self.sx
+        self.include_bias = False
+        if weights_from is not None:
+            self.weights = weights_from.weights
+        self.hits = Array()
+        self.total = 0                       # samples accumulated into hits
+        #: link from loader.minibatch_size so padded tail rows aren't counted
+        self.batch_size: Optional[int] = None
+
+    def output_shape_for(self, in_shape):
+        return (in_shape[0],)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+
+        d = self.distances(x.reshape(x.shape[0], -1), params["weights"])
+        return jnp.argmin(d, axis=1)
+
+    def initialize(self, device=None, **kwargs):
+        if self.weights.mem is None:
+            self.init_weights((self.n_neurons, self.input.sample_size), ())
+        self.hits.mem = np.zeros(self.n_neurons, np.int64)
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+        self.hits.initialize(device)
+
+    def create_output(self):
+        self.output.mem = np.zeros(self.input.shape[0], np.int32)
+
+    def reset_hits(self):
+        self.hits.map_invalidate()[...] = 0
+        self.total = 0
+
+    def run(self):
+        super().run()
+        winners = np.asarray(self.output.map_read())
+        if self.batch_size is not None:
+            winners = winners[:int(self.batch_size)]
+        hits = self.hits.map_write()
+        np.add.at(hits, winners, 1)
+        self.total += len(winners)
+
+
+class KohonenTrainer(Unit, KohonenBase):
+    """Batch SOM trainer with exponentially decaying radius and lr."""
+
+    def __init__(self, workflow=None, name=None, shape=(8, 8),
+                 learning_rate=0.1, radius: Optional[float] = None,
+                 decay_epochs=20, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.sy, self.sx = int(shape[0]), int(shape[1])
+        self.n_neurons = self.sy * self.sx
+        self.input: Optional[Array] = None     # linked: minibatch_data
+        self.batch_size = 0                    # linked: minibatch_size
+        self.weights = Array()
+        self.learning_rate = float(learning_rate)
+        self.radius0 = float(radius if radius is not None
+                             else max(self.sy, self.sx) / 2.0)
+        self.decay_epochs = float(decay_epochs)
+        self.time = 0                          # epochs elapsed (linked or set)
+        self.epoch_number = 0                  # link from loader
+        #: mean squared quantization error of the last minibatch
+        self.qerror = 0.0
+        self._coords = grid_coords(self.sy, self.sx)
+        self._compiled = None
+
+    def current_lr_sigma(self):
+        t = float(self.epoch_number)
+        decay = np.exp(-t / self.decay_epochs)
+        lr = self.learning_rate * decay
+        sigma = max(self.radius0 * decay, 0.5)
+        return np.float32(lr), np.float32(sigma)
+
+    @staticmethod
+    def _step(w, x, coords, batch_size, lr, sigma):
+        import jax.numpy as jnp
+
+        xf = x.reshape(x.shape[0], -1)
+        n = xf.shape[0]
+        valid = (jnp.arange(n) < batch_size)[:, None]
+        d = KohonenBase.distances(xf, w)
+        winners = jnp.argmin(d, axis=1)                       # (B,)
+        qerr = jnp.sum(jnp.min(d, axis=1) * valid[:, 0]) / \
+            jnp.maximum(batch_size, 1)
+        # gravity: (B, N) gaussian of grid distance to each winner
+        gd = jnp.sum(jnp.square(coords[winners][:, None, :]
+                                - coords[None, :, :]), axis=-1)
+        g = jnp.exp(-gd / (2.0 * sigma * sigma)) * valid
+        # batched update: w_i += lr * sum_b g[b,i] (x_b - w_i) / B
+        num = g.T @ xf                                         # (N, D) MXU
+        den = jnp.sum(g, axis=0)[:, None]                      # (N, 1)
+        w_new = w + lr * (num - den * w) / jnp.maximum(batch_size, 1)
+        return w_new, qerr
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if self.weights.mem is None:
+            gen = prng.get(self.name)
+            self.weights.mem = gen.uniform(
+                -0.1, 0.1, (self.n_neurons, self.input.sample_size))
+        self.weights.initialize(device)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self._step)
+        lr, sigma = self.current_lr_sigma()
+        w_new, qerr = self._compiled(
+            self.weights.devmem, self.input.devmem,
+            np.asarray(self._coords), np.int32(int(self.batch_size)),
+            lr, sigma)
+        self.weights.devmem = w_new
+        self.qerror = float(qerr)
+
+
+class KohonenDecision(Unit):
+    """Training control for the SOM loop: tracks mean quantization error
+    per epoch; completes on max_epochs (the reference stopped on epochs /
+    weight-delta)."""
+
+    def __init__(self, workflow=None, name=None, max_epochs=10, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        from znicz_tpu.core.mutable import Bool
+
+        self.max_epochs = int(max_epochs)
+        self.complete = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = False            # link from loader
+        self.epoch_number = 0                  # link from loader
+        self.qerror = 0.0                      # link from trainer
+        self._acc = 0.0
+        self._batches = 0
+        self.epoch_qerror = []
+        self.on_epoch_end = []
+
+    def run(self):
+        self._acc += float(self.qerror)
+        self._batches += 1
+        self.epoch_ended.set(False)
+        if self.last_minibatch:
+            self.epoch_qerror.append(self._acc / max(1, self._batches))
+            self._acc, self._batches = 0.0, 0
+            self.epoch_ended.set(True)
+            self.complete.set(self.epoch_number + 1 >= self.max_epochs)
+            self.info("epoch %d  qerror=%.6g", self.epoch_number,
+                      self.epoch_qerror[-1])
+            for cb in self.on_epoch_end:
+                cb(self)

@@ -79,6 +79,43 @@ class PoolingBase(ForwardBase):
         win = win.transpose(0, 1, 2, 5, 3, 4)       # (B, OH, OW, C, ky, kx)
         return win.reshape(b, oh, ow, c, self.ky * self.kx)
 
+    def _offset_grids(self, offsets):
+        """(bidx, ay, ax, cidx) absolute padded-input coordinates for
+        window-relative ``offsets`` — the single home of the offset
+        convention shared by the GD scatter and Depooling (adjointness
+        depends on all users agreeing on this math)."""
+        import jax.numpy as jnp
+
+        b, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        oy = np.arange(oh)[None, :, None, None]
+        ox = np.arange(ow)[None, None, :, None]
+        ay = oy * sy + offsets // self.kx
+        ax = ox * sx + offsets % self.kx
+        bidx = jnp.arange(b)[:, None, None, None]
+        cidx = jnp.arange(c)[None, None, None, :]
+        return bidx, ay, ax, cidx
+
+    def scatter_at_offsets(self, values, offsets):
+        """Input-shaped array with ``values`` scatter-added at the recorded
+        positions (the max/stochastic backward and Depooling forward)."""
+        import jax.numpy as jnp
+
+        b, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        bidx, ay, ax, cidx = self._offset_grids(offsets)
+        padded = jnp.zeros((b, ph, pw, c), values.dtype)
+        padded = padded.at[bidx, ay, ax, cidx].add(values)
+        return padded[:, :h, :w, :]
+
+    def gather_at_offsets(self, full, offsets):
+        """Output-shaped gather of an input-shaped array at the recorded
+        positions (the Depooling backward — exact adjoint of the scatter)."""
+        import jax.numpy as jnp
+
+        b, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        bidx, ay, ax, cidx = self._offset_grids(offsets)
+        padded = jnp.pad(full, ((0, 0), (0, ph - h), (0, pw - w), (0, 0)))
+        return padded[bidx, ay, ax, cidx]
+
     def initialize(self, device=None, **kwargs):
         self.create_output()
         self.input_offset.initialize(device)

@@ -1,0 +1,116 @@
+"""RBM building blocks (rebuild of ``znicz/rbm_unit.py``).
+
+The reference decomposed contrastive-divergence training for a binary RBM
+into units; the rebuild keeps that surface:
+
+  - ``Binarization`` — stochastic binarize: out ~ Bernoulli(input) from the
+    seeded device PRNG (inputs must be in [0, 1]);
+  - the hidden layer is an ordinary ``All2AllSigmoid`` (h = σ(Wv + b_h));
+  - ``GradientRBM`` — one CD-1 step against the hidden layer's tied
+    weights/bias + its own visible bias:
+        h0 ~ Bern(σ(W v0 + b_h));  v1 = σ(Wᵀ h0 + b_v);  h1 = σ(W v1 + b_h)
+        ΔW ∝ h0ᵀ v0 − h1ᵀ v1;  Δb_h ∝ mean(h0 − h1);  Δb_v ∝ mean(v0 − v1)
+    and reports per-minibatch reconstruction error (mean ||v0−v1||²).
+
+One jitted step; the GEMMs ride the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.memory import Array
+from znicz_tpu.core.units import Unit
+from znicz_tpu.nn_units import ForwardBase
+
+
+class Binarization(ForwardBase):
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self._step_counter = 0
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        raise NotImplementedError("stochastic unit; use run()")
+
+    def initialize(self, device=None, **kwargs):
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+
+            def sample(x, key):
+                return jax.random.bernoulli(key, x).astype("float32")
+
+            self._compiled = jax.jit(sample)
+        key = prng.get(self.name).jax_key(self._step_counter)
+        self._step_counter += 1
+        self.output.devmem = self._compiled(self.input.devmem, key)
+
+
+class GradientRBM(Unit):
+    """CD-1 trainer tied to a hidden ``All2AllSigmoid`` unit."""
+
+    def __init__(self, workflow=None, name=None, hidden=None,
+                 learning_rate=0.1, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        assert hidden is not None, "GradientRBM needs hidden=<All2AllSigmoid>"
+        self.hidden = hidden
+        self.learning_rate = float(learning_rate)
+        self.input: Optional[Array] = None      # linked: v0 (minibatch_data)
+        self.batch_size = 0                     # linked: minibatch_size
+        self.vbias = Array()
+        self.reconstruction_error = 0.0
+        self._step_counter = 0
+        self._compiled = None
+
+    @staticmethod
+    def _step(w, bh, bv, v0, batch_size, lr, key):
+        import jax
+        import jax.numpy as jnp
+
+        v0 = v0.reshape(v0.shape[0], -1)
+        n = v0.shape[0]
+        valid = (jnp.arange(n) < batch_size)[:, None].astype(v0.dtype)
+        v0 = v0 * valid
+        h0p = jax.nn.sigmoid(v0 @ w.T + bh) * valid
+        h0 = jax.random.bernoulli(key, h0p).astype(v0.dtype) * valid
+        v1 = jax.nn.sigmoid(h0 @ w + bv) * valid
+        h1p = jax.nn.sigmoid(v1 @ w.T + bh) * valid
+        b = jnp.maximum(batch_size, 1)
+        dw = (h0p.T @ v0 - h1p.T @ v1) / b
+        dbh = jnp.sum(h0p - h1p, axis=0) / b
+        dbv = jnp.sum(v0 - v1, axis=0) / b
+        rec = jnp.sum(jnp.square(v0 - v1)) / b
+        return w + lr * dw, bh + lr * dbh, bv + lr * dbv, rec
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if self.vbias.mem is None:
+            self.vbias.mem = np.zeros(self.input.sample_size, np.float32)
+        self.vbias.initialize(device)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self._step)
+        key = prng.get(self.name).jax_key(self._step_counter)
+        self._step_counter += 1
+        w, bh, bv, rec = self._compiled(
+            self.hidden.weights.devmem, self.hidden.bias.devmem,
+            self.vbias.devmem, self.input.devmem,
+            np.int32(int(self.batch_size)),
+            np.float32(self.learning_rate), key)
+        self.hidden.weights.devmem = w
+        self.hidden.bias.devmem = bh
+        self.vbias.devmem = bv
+        self.reconstruction_error = float(rec)

@@ -1,0 +1,98 @@
+"""Kohonen sample: self-organizing map on 2-D gaussian clusters — rebuild of
+the reference's ``znicz/samples/Kohonen`` workflow, BASELINE config[3].
+Unsupervised: no evaluator/GD chain; the trainer is the learning rule and
+the forward unit accumulates the hit map (behavioral-parity artifact)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.core.workflow import Repeater, Workflow
+from znicz_tpu.kohonen import KohonenDecision, KohonenForward, KohonenTrainer
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+root.kohonen.defaults({
+    "loader": {"minibatch_size": 50, "n_train": 1000, "n_clusters": 10},
+    "som": {"shape": (8, 8), "learning_rate": 0.5, "decay_epochs": 15},
+    "decision": {"max_epochs": 10},
+})
+
+
+def cluster_points(n: int, n_clusters: int,
+                   stream: str = "dataset.kohonen") -> np.ndarray:
+    """2-D points from gaussian clusters on a ring (deterministic)."""
+    gen = prng.get(stream)
+    rng = gen.state
+    which = rng.integers(0, n_clusters, size=n)
+    angles = 2 * np.pi * which / n_clusters
+    centers = np.stack([np.cos(angles), np.sin(angles)], axis=1)
+    return (centers + rng.normal(0, 0.08, size=(n, 2))).astype(np.float32)
+
+
+class KohonenLoader(FullBatchLoader):
+    def load_data(self):
+        cfg = root.kohonen.loader
+        n = int(cfg.get("n_train"))
+        self.original_data.mem = cluster_points(
+            n, int(cfg.get("n_clusters")))
+        self.class_lengths = [0, 0, n]
+        super().load_data()
+
+    def create_minibatch_data(self):
+        super().create_minibatch_data()
+        self.minibatch_labels.mem = None    # unsupervised
+
+
+class KohonenWorkflow(Workflow):
+    def __init__(self, **kwargs):
+        super().__init__(name="KohonenWorkflow", **kwargs)
+        cfg = root.kohonen
+        shape = tuple(cfg.som.get("shape"))
+
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+        self.loader = KohonenLoader(
+            self, name="loader",
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        self.loader.link_from(self.repeater)
+
+        self.trainer = KohonenTrainer(
+            self, name="trainer", shape=shape,
+            learning_rate=float(cfg.som.get("learning_rate")),
+            decay_epochs=float(cfg.som.get("decay_epochs")))
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("batch_size", "minibatch_size"),
+                                "epoch_number")
+
+        self.forward = KohonenForward(self, name="forward", shape=shape,
+                                      weights_from=self.trainer)
+        self.forward.link_from(self.trainer)
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("batch_size", "minibatch_size"))
+
+        self.decision = KohonenDecision(
+            self, name="decision",
+            max_epochs=int(cfg.decision.get("max_epochs")))
+        self.decision.link_from(self.forward)
+        self.decision.link_attrs(self.loader, "last_minibatch",
+                                 "epoch_number")
+        self.decision.link_attrs(self.trainer, "qerror")
+
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(device=None) -> KohonenWorkflow:
+    wf = KohonenWorkflow()
+    wf.initialize(device=device)
+    wf.run()
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

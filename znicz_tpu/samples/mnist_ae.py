@@ -1,0 +1,159 @@
+"""MnistAE sample: convolutional autoencoder — rebuild of the reference's
+``znicz/samples/MnistAE`` workflow, BASELINE config[2].
+
+Architecture (the reference's): ConvTanh encoder -> MaxPooling ->
+Depooling (routed by the pooling's recorded offsets) -> Deconv decoder with
+weights *tied* to the encoder conv, trained by GDDeconv against
+EvaluatorMSE(target = input image), DecisionMSE control.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.conv import ConvTanh
+from znicz_tpu.core.config import root
+from znicz_tpu.core.workflow import Repeater, Workflow
+from znicz_tpu.decision import DecisionMSE
+from znicz_tpu.deconv import Deconv
+from znicz_tpu.depooling import Depooling, GDDepooling
+from znicz_tpu.evaluator import EvaluatorMSE
+from znicz_tpu.gd_conv import GDTanhConv
+from znicz_tpu.gd_deconv import GDDeconv
+from znicz_tpu.gd_pooling import GDMaxPooling
+from znicz_tpu.loader.fullbatch import FullBatchLoaderMSE
+from znicz_tpu.pooling import MaxPooling
+from znicz_tpu.snapshotter import Snapshotter
+
+root.mnist_ae.defaults({
+    "loader": {"minibatch_size": 100, "n_train": 2000, "n_valid": 400,
+               "n_test": 0, "data_path": ""},
+    "conv": {"n_kernels": 9, "kx": 5, "ky": 5, "padding": (2, 2, 2, 2),
+             "sliding": (1, 1)},
+    "pooling": {"kx": 2, "ky": 2},
+    "learning_rate": 0.0003,     # MSE grads sum over pixels — keep lr small
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0,
+    "decision": {"max_epochs": 5, "fail_iterations": 0},
+    "snapshotter": {"prefix": "mnist_ae", "interval": 0},
+})
+
+
+class MnistAELoader(FullBatchLoaderMSE):
+    def load_data(self):
+        cfg = root.mnist_ae.loader
+        n_train = int(cfg.get("n_train"))
+        n_valid = int(cfg.get("n_valid"))
+        n_test = int(cfg.get("n_test"))
+        total = n_train + n_valid + n_test
+        data, _ = datasets.load_or_generate(
+            cfg.get("data_path") or None, datasets.digits, total)
+        self.original_data.mem = data[..., None]     # NHWC, C=1
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+
+class MnistAEWorkflow(Workflow):
+    def __init__(self, **kwargs):
+        super().__init__(name="MnistAEWorkflow", **kwargs)
+        cfg = root.mnist_ae
+        gd_kw = {"learning_rate": float(cfg.get("learning_rate")),
+                 "gradient_moment": float(cfg.get("gradient_moment")),
+                 "weights_decay": float(cfg.get("weights_decay"))}
+
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+        self.loader = MnistAELoader(
+            self, name="loader", targets_from_data=True,
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        self.loader.link_from(self.repeater)
+
+        conv_cfg = cfg.conv.to_dict()
+        self.conv = ConvTanh(self, name="conv", **conv_cfg)
+        self.conv.link_from(self.loader)
+        self.conv.link_attrs(self.loader, ("input", "minibatch_data"))
+
+        self.pool = MaxPooling(self, name="pool",
+                               kx=int(cfg.pooling.get("kx")),
+                               ky=int(cfg.pooling.get("ky")))
+        self.pool.link_from(self.conv)
+        self.pool.link_attrs(self.conv, ("input", "output"))
+
+        self.depool = Depooling(self, name="depool", pooling_from=self.pool)
+        self.depool.link_from(self.pool)
+        self.depool.link_attrs(self.pool, ("input", "output"))
+
+        # decoder deconv: weights tied to the encoder conv (reference AE)
+        self.deconv = Deconv(self, name="deconv", weights_from=self.conv)
+        self.deconv.link_from(self.depool)
+        self.deconv.link_attrs(self.depool, ("input", "output"))
+        self.deconv.output_shape_from = self.conv.input
+
+        self.evaluator = EvaluatorMSE(self, name="evaluator")
+        self.evaluator.link_from(self.deconv)
+        self.evaluator.link_attrs(self.deconv, "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("target", "minibatch_targets"),
+                                  ("batch_size", "minibatch_size"))
+
+        self.decision = DecisionMSE(
+            self, name="decision",
+            max_epochs=int(cfg.decision.get("max_epochs")),
+            fail_iterations=int(cfg.decision.get("fail_iterations")))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch", "class_ended",
+            "epoch_number", "class_lengths", "minibatch_size")
+        self.decision.link_attrs(self.evaluator, ("minibatch_loss", "loss"))
+
+        self.snapshotter = Snapshotter(
+            self, name="snapshotter",
+            prefix=cfg.snapshotter.get("prefix"),
+            interval=int(cfg.snapshotter.get("interval", 0)))
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(self.decision, "epoch_number")
+        self.snapshotter.improved = self.decision.improved
+        self.snapshotter.gate_skip = ~self.decision.epoch_ended
+
+        # backward chain: deconv -> depool -> pool -> conv
+        self.gd_deconv = GDDeconv(self, name="gd_deconv",
+                                  forward=self.deconv, **gd_kw)
+        self.gd_deconv.link_from(self.snapshotter)
+        self.gd_deconv.link_attrs(self.evaluator, "err_output")
+
+        self.gd_depool = GDDepooling(self, name="gd_depool",
+                                     forward=self.depool)
+        self.gd_depool.link_from(self.gd_deconv)
+        self.gd_depool.link_attrs(self.gd_deconv,
+                                  ("err_output", "err_input"))
+
+        self.gd_pool = GDMaxPooling(self, name="gd_pool", forward=self.pool)
+        self.gd_pool.link_from(self.gd_depool)
+        self.gd_pool.link_attrs(self.gd_depool, ("err_output", "err_input"))
+
+        self.gd_conv = GDTanhConv(self, name="gd_conv", forward=self.conv,
+                                  need_err_input=False, **gd_kw)
+        self.gd_conv.link_from(self.gd_pool)
+        self.gd_conv.link_attrs(self.gd_pool, ("err_output", "err_input"))
+
+        for gd in (self.gd_deconv, self.gd_depool, self.gd_pool,
+                   self.gd_conv):
+            gd.gate_skip = self.decision.gd_skip
+
+        self.repeater.link_from(self.gd_conv)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(snapshot: str = "", device=None) -> MnistAEWorkflow:
+    wf = MnistAEWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    wf.run()
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

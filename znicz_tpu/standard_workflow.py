@@ -70,13 +70,12 @@ def _registry() -> Dict[str, Tuple[Type, Optional[Type]]]:
         "activation_sincos": (act.ForwardSinCos, act.BackwardSinCos),
         "activation_tanhlog": (act.ForwardTanhLog, act.BackwardTanhLog),
     }
-    try:
-        from znicz_tpu import deconv, depooling, gd_deconv
+    from znicz_tpu import deconv, depooling, gd_deconv
 
-        reg["deconv"] = (deconv.Deconv, gd_deconv.GDDeconv)
-        reg["depooling"] = (depooling.Depooling, None)
-    except ImportError:
-        pass
+    reg["deconv"] = (deconv.Deconv, gd_deconv.GDDeconv)
+    reg["deconv_tanh"] = (deconv.DeconvTanh, gd_deconv.GDDeconvTanh)
+    reg["deconv_sigmoid"] = (deconv.DeconvSigmoid, gd_deconv.GDDeconvSigmoid)
+    reg["depooling"] = (depooling.Depooling, depooling.GDDepooling)
     try:
         from znicz_tpu import resizable_all2all
 
@@ -179,7 +178,6 @@ class StandardWorkflowBase(Workflow):
     def create_gd_units(self):
         reg = _registry()
         err_src, err_attr = self.evaluator, "err_output"
-        first_trainable = 0
         tail = self.snapshotter
         for i in reversed(range(len(self.forwards))):
             fwd = self.forwards[i]
@@ -190,7 +188,7 @@ class StandardWorkflowBase(Workflow):
                     f"layer {fwd.layer_kind!r} has no backward unit and "
                     "cannot sit inside a GD chain")
             gd = gd_cls(self, name=f"gd_{fwd.layer_kind}_{i}", forward=fwd,
-                        need_err_input=(i > first_trainable),
+                        need_err_input=(i > 0),
                         **layer.get("<-", {}))
             gd.link_from(tail)
             gd.link_attrs(err_src, ("err_output", err_attr))
