@@ -1,0 +1,83 @@
+"""Benchmark harness: AlexNet fused-train-step throughput on the attached
+chip (BASELINE.md north-star metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` divides by 500 img/s — the widely published cuDNN-Caffe
+AlexNet training throughput on a K40, standing in for the reference's own
+number, which is unobtainable here (BASELINE.md: reference mount empty, no
+network).  Update BASELINE.json.published when a real number lands.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+K40_ALEXNET_IMG_S = 500.0   # documented stand-in (see module docstring)
+
+BATCH = 128
+WARMUP = 3
+STEPS = 20
+
+
+def main() -> None:
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+
+    prng.seed_all(1013)
+    root.common.engine.precision = "bfloat16"   # params fp32, MXU bf16
+    root.alexnet.loader.minibatch_size = BATCH
+    root.alexnet.loader.n_train = BATCH * 2
+    root.alexnet.loader.n_valid = BATCH
+    root.alexnet.loader.n_classes = 100
+    root.alexnet.decision.max_epochs = 1
+
+    import jax
+
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples.alexnet import AlexNetWorkflow
+
+    wf = AlexNetWorkflow()
+    wf.initialize(device=None)
+    trainer = FusedTrainer(wf)
+    step = trainer.make_train_step()
+    params = trainer.extract_params()
+    vels = trainer.extract_velocities()
+    dataset = wf.loader.original_data.devmem
+    targets = wf.loader.original_labels.devmem
+    wf.loader.run()
+    while wf.loader.minibatch_class != 2:       # reach a TRAIN minibatch
+        wf.loader.run()
+    idx = wf.loader.minibatch_indices.devmem
+    bs = np.int32(wf.loader.minibatch_size)
+
+    hypers = trainer.hypers()
+    for i in range(WARMUP):
+        params, vels, metrics = step(params, vels, hypers, dataset, targets,
+                                     idx, bs, prng.get("bench").jax_key(i))
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        params, vels, metrics = step(params, vels, hypers, dataset, targets,
+                                     idx, bs,
+                                     prng.get("bench").jax_key(100 + i))
+    jax.block_until_ready(metrics)
+    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+    elapsed = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / elapsed
+    print(json.dumps({
+        "metric": "alexnet_imagenet_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / K40_ALEXNET_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Aux subsystems: lr_adjust policies, plotting units, image saver,
+genetics GA, launcher CLI."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import Config, root
+
+
+def test_lr_policies():
+    from znicz_tpu.lr_adjust import (ExpPolicy, FixedPolicy, InvPolicy,
+                                     StepPolicy, make_policy)
+
+    assert FixedPolicy()(0.1, 500) == 0.1
+    assert abs(StepPolicy(gamma=0.1, step=100)(1.0, 250) - 0.01) < 1e-12
+    assert abs(ExpPolicy(gamma=0.5)(1.0, 3) - 0.125) < 1e-12
+    inv = InvPolicy(gamma=0.1, power=1.0)
+    assert abs(inv(1.0, 10) - 0.5) < 1e-12
+    assert isinstance(make_policy("step"), StepPolicy)
+
+
+def test_lr_adjust_rewrites_gd_rates():
+    from znicz_tpu.all2all import All2All
+    from znicz_tpu.gd import GradientDescent
+    from znicz_tpu.lr_adjust import ExpPolicy, LearningRateAdjust
+    from znicz_tpu.memory import Array
+
+    fwd = All2All(name="lrfwd", output_sample_shape=(2,))
+    fwd.input = Array(np.ones((2, 3), np.float32))
+    fwd.initialize(device=None)
+    gd = GradientDescent(name="lrgd", forward=fwd, learning_rate=1.0)
+    adj = LearningRateAdjust(name="lra")
+    adj.add_gd(gd, ExpPolicy(gamma=0.5))
+    adj.run()
+    assert gd.learning_rate == 1.0      # iteration 0
+    adj.run()
+    assert gd.learning_rate == 0.5
+    adj.run()
+    assert gd.learning_rate == 0.25
+
+
+def test_plotters_render_pngs(tmp_path):
+    from znicz_tpu.memory import Array
+    from znicz_tpu.plotting_units import (AccumulatingPlotter, MatrixPlotter,
+                                          MultiHistogram, Weights2D)
+
+    root.common.dirs.plots = str(tmp_path)
+    vals = iter([3.0, 2.0, 1.0])
+    acc = AccumulatingPlotter(name="acc_plot", fetch=lambda: next(vals))
+    for _ in range(3):
+        acc.run()
+    assert acc.values == [3.0, 2.0, 1.0]
+    assert os.path.exists(acc.path())
+
+    w = Weights2D(name="w_plot",
+                  source=Array(np.random.default_rng(0).normal(
+                      size=(9, 16)).astype(np.float32)),
+                  sample_shape=(4, 4))
+    w.run()
+    assert os.path.exists(w.path())
+
+    m = MatrixPlotter(name="conf_plot",
+                      fetch=lambda: np.eye(4, dtype=np.int32))
+    m.run()
+    assert os.path.exists(m.path())
+
+    h = MultiHistogram(name="hist_plot",
+                       source=Array(np.random.default_rng(1).normal(
+                           size=(100,)).astype(np.float32)))
+    h.run()
+    assert os.path.exists(h.path())
+
+
+def test_image_saver(tmp_path):
+    from znicz_tpu.image_saver import ImageSaver
+    from znicz_tpu.memory import Array
+
+    root.common.dirs.image_saver = str(tmp_path)
+    sv = ImageSaver(name="imgsave", limit=8)
+    rng = np.random.default_rng(3)
+    sv.input = Array(rng.random(size=(4, 16)).astype(np.float32))
+    sv.labels = Array(np.array([0, 1, 2, 3], np.int32))
+    probs = np.full((4, 4), 0.1, np.float32)
+    probs[np.arange(4), [0, 1, 0, 0]] = 0.7   # samples 2,3 misclassified
+    sv.output = Array(probs)
+    sv.batch_size = 4
+    sv.epoch_number = 0
+    sv.last_minibatch = True
+    sv.run()
+    files = os.listdir(os.path.join(str(tmp_path), "epoch_0"))
+    assert len(files) == 2
+    assert any(f.startswith("2_as_0") for f in files)
+
+
+def test_genetics_finds_minimum():
+    from znicz_tpu.genetics import GeneticsOptimizer, Tune, find_tunes
+
+    cfg = Config("groot")
+    cfg.model.x = Tune(5.0, -10.0, 10.0)
+    cfg.model.y = Tune(-3.0, -10.0, 10.0)
+    tunes = find_tunes(cfg)
+    assert [p for p, _ in tunes] == ["model.x", "model.y"]
+
+    def evaluate():
+        x = cfg.model.get("x")
+        y = cfg.model.get("y")
+        return (x - 2.0) ** 2 + (y - 1.0) ** 2
+
+    opt = GeneticsOptimizer(evaluate, cfg, generations=12, population=12)
+    best, fitness = opt.run()
+    assert fitness < 0.5, (best, fitness)
+    assert abs(cfg.model.get("x") - 2.0) < 1.0
+
+
+def test_launcher_runs_sample(tmp_path, capsys):
+    from znicz_tpu.launcher import main
+
+    root.common.dirs.snapshots = str(tmp_path)
+    rc = main(["mnist",
+               "root.mnist.loader.n_train=120",
+               "root.mnist.loader.n_valid=60",
+               "root.mnist.loader.minibatch_size=60",
+               "root.mnist.decision.max_epochs=1",
+               "--workflow-graph", str(tmp_path / "g.dot")])
+    assert rc == 0
+    dot = (tmp_path / "g.dot").read_text()
+    assert "repeater" in dot and "->" in dot
+
+
+def test_launcher_list():
+    from znicz_tpu.launcher import main
+
+    assert main(["--list"]) == 0

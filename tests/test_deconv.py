@@ -113,6 +113,24 @@ def test_depooling_scatters_to_pool_offsets():
             assert got[0, oy, ox, 0] == err[0, oy * 2 + dy, ox * 2 + dx, 0]
 
 
+def test_depooling_over_avg_pooling_spreads_uniformly():
+    from znicz_tpu.pooling import AvgPooling
+
+    x = np.ones((1, 4, 4, 1), np.float32)
+    pool = AvgPooling(name="dpa", kx=2, ky=2)
+    pool.input = Array(x)
+    pool.initialize(device=None)
+    pool.run()
+    v = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+    dep = Depooling(name="dpau", pooling_from=pool)
+    dep.input = Array(v)
+    dep.initialize(device=None)
+    dep.run()
+    up = np.array(dep.output.map_read())
+    want = np.repeat(np.repeat(v, 2, axis=1), 2, axis=2) / 4.0
+    np.testing.assert_allclose(up, want, rtol=1e-6)
+
+
 @pytest.fixture
 def small_ae(tmp_path):
     root.mnist_ae.loader.n_train = 400

@@ -1,0 +1,96 @@
+"""FusedTrainer: parity with the unit-at-a-time engine, and 8-virtual-device
+data parallelism (SURVEY.md §4: multi-device tests on CPU)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+
+def fresh_mnist(max_epochs=2):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng._streams.clear()
+    prng.seed_all(1013)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = max_epochs
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def run_unit(wf):
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    wf.run()
+    return losses, {f.name: np.array(f.weights.map_read())
+                    for f in wf.forwards}
+
+
+def run_fused(wf, mesh=None):
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    FusedTrainer(wf, mesh=mesh).run()
+    return losses, {f.name: np.array(f.weights.map_read())
+                    for f in wf.forwards}
+
+
+def test_fused_matches_unit_path(tmp_path):
+    root.common.dirs.snapshots = str(tmp_path)
+    lu, wu = run_unit(fresh_mnist())
+    lf, wf_ = run_fused(fresh_mnist())
+    np.testing.assert_allclose(lu, lf, rtol=1e-4)
+    for name in wu:
+        np.testing.assert_allclose(wu[name], wf_[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def test_fused_data_parallel_8dev_matches_single(tmp_path):
+    import jax
+
+    root.common.dirs.snapshots = str(tmp_path)
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    l1, w1 = run_fused(fresh_mnist())
+    mesh = make_mesh(axes=("data",))
+    l8, w8 = run_fused(fresh_mnist(), mesh=mesh)
+    np.testing.assert_allclose(l1, l8, rtol=1e-4)
+    for name in w1:
+        np.testing.assert_allclose(w1[name], w8[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def test_fused_snapshotter_fires(tmp_path):
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = fresh_mnist()
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    FusedTrainer(wf).run()
+    assert wf.snapshotter.destination is not None
+    import os
+    assert os.path.exists(wf.snapshotter.destination)
+
+
+def test_fused_rejects_tied_weights(tmp_path):
+    root.common.dirs.snapshots = str(tmp_path)
+    root.mnist_ae.loader.n_train = 100
+    root.mnist_ae.loader.n_valid = 50
+    root.mnist_ae.loader.minibatch_size = 50
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist_ae
+
+    wf = mnist_ae.MnistAEWorkflow()
+    wf.initialize(device=None)
+    wf.forwards = [wf.conv, wf.pool, wf.depool, wf.deconv]
+    wf.gds = [wf.gd_deconv, wf.gd_depool, wf.gd_pool, wf.gd_conv]
+    with pytest.raises(ValueError, match="tied"):
+        FusedTrainer(wf)

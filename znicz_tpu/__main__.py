@@ -1,0 +1,5 @@
+import sys
+
+from znicz_tpu.launcher import main
+
+sys.exit(main())

@@ -56,12 +56,16 @@ class Conv(ForwardBase):
 
         w = params["weights"]                       # (K, ky, kx, C)
         left, top, right, bottom = self.padding
+        # f32 accumulation: explicit for f32 operands; bf16 operands keep a
+        # bf16 output (MXU accumulates f32 internally) so vjp cotangent
+        # dtypes stay consistent in mixed precision
+        pref = np.float32 if x.dtype == np.float32 else None
         y = lax.conv_general_dilated(
             x, jnp_transpose_hwio(w),
             window_strides=self.sliding,
             padding=((top, bottom), (left, right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=np.float32)
+            preferred_element_type=pref)
         if self.include_bias:
             y = y + params["bias"]
         return type(self).ACTIVATION(y)

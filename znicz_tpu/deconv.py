@@ -80,13 +80,17 @@ class Deconv(ForwardBase):
         h, wdt, c = self._target_hwc()
         left, top, right, bottom = self.padding
 
+        # same mixed-precision rule as conv.py: f32 output only for f32
+        # operands, else the vjp cotangent dtypes diverge under bf16
+        pref = np.float32 if x.dtype == np.float32 else None
+
         def conv_fwd(ximg):
             return lax.conv_general_dilated(
                 ximg, w.transpose(1, 2, 3, 0),
                 window_strides=self.sliding,
                 padding=((top, bottom), (left, right)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                preferred_element_type=np.float32)
+                preferred_element_type=pref)
 
         zeros = jax.numpy.zeros((x.shape[0], h, wdt, c), x.dtype)
         _, vjp = jax.vjp(conv_fwd, zeros)

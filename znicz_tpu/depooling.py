@@ -29,12 +29,34 @@ class Depooling(ForwardBase):
         self.create_output()
         super().initialize(device=device, **kwargs)
 
+    def _has_offsets(self) -> bool:
+        return bool(self.pooling.input_offset)
+
     def run(self):
         if self._compiled is None:
             import jax
-            self._compiled = jax.jit(self.pooling.scatter_at_offsets)
-        self.output.devmem = self._compiled(
-            self.input.devmem, self.pooling.input_offset.devmem)
+
+            if self._has_offsets():
+                self._compiled = jax.jit(self.pooling.scatter_at_offsets)
+            else:
+                # AvgPooling records no offsets: spread uniformly — the
+                # exact adjoint of the average (vjp of the pooling forward)
+                import jax.numpy as jnp
+
+                pool = self.pooling
+                in_shape = tuple(pool.input.shape)
+
+                def spread(values, _offsets_unused=None):
+                    zeros = jnp.zeros(in_shape, values.dtype)
+                    _, vjp = jax.vjp(lambda x: pool.apply({}, x), zeros)
+                    return vjp(values)[0]
+
+                self._compiled = jax.jit(spread)
+        if self._has_offsets():
+            self.output.devmem = self._compiled(
+                self.input.devmem, self.pooling.input_offset.devmem)
+        else:
+            self.output.devmem = self._compiled(self.input.devmem)
 
 
 class GDDepooling(GradientDescentBase):

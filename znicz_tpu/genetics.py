@@ -1,0 +1,137 @@
+"""Genetic hyperparameter optimization (rebuild of ``veles/genetics/``).
+
+The reference wrapped numeric config leaves in ``Tune`` ranges and ran a GA
+whose individuals are full workflow runs (multiprocess fan-out).  Rebuild
+keeps the surface:
+
+  - ``Tune(default, min, max)`` — mark a config leaf as tunable::
+
+        root.mnist.learning_rate = Tune(0.1, 0.01, 1.0)
+
+  - ``GeneticsOptimizer(evaluate, config_root, generations, population)``
+    — finds all Tune leaves under ``config_root``, evolves real-valued
+    chromosomes (tournament selection, blend crossover, gaussian mutation),
+    writes each individual's values into the config tree and calls
+    ``evaluate() -> fitness`` (lower is better: final validation error).
+
+Runs are sequential here (one accelerator); the reference's multiprocess
+evaluation maps onto launching independent runs per chip at the CLI level.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import Config
+
+
+class Tune:
+    """A tunable numeric config leaf."""
+
+    def __init__(self, default, minimum, maximum):
+        self.default = float(default)
+        self.min = float(minimum)
+        self.max = float(maximum)
+
+    def __float__(self):
+        return self.default
+
+    def __repr__(self):
+        return f"Tune({self.default}, [{self.min}, {self.max}])"
+
+
+def find_tunes(cfg: Config, prefix: str = "") -> List[Tuple[str, Tune]]:
+    out = []
+    for key, value in cfg.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(value, Tune):
+            out.append((path, value))
+        elif isinstance(value, Config):
+            out.extend(find_tunes(value, path))
+    return out
+
+
+class GeneticsOptimizer:
+    def __init__(self, evaluate: Callable[[], float], config_root: Config,
+                 generations: int = 5, population: int = 8,
+                 mutation_rate: float = 0.25, elite: int = 1):
+        self.evaluate = evaluate
+        self.config_root = config_root
+        self.tunes = find_tunes(config_root)
+        if not self.tunes:
+            raise ValueError("no Tune leaves found under the config root")
+        self.generations = int(generations)
+        self.population_size = int(population)
+        self.mutation_rate = float(mutation_rate)
+        self.elite = int(elite)
+        self.rng = prng.get("genetics").state
+        self.best_chromo = None
+        self.best_fitness = np.inf
+        self.history: List[float] = []
+
+    # -- chromosome plumbing ---------------------------------------------------
+
+    def _random_chromo(self) -> np.ndarray:
+        return np.array([self.rng.uniform(t.min, t.max)
+                         for _, t in self.tunes])
+
+    def _default_chromo(self) -> np.ndarray:
+        return np.array([t.default for _, t in self.tunes])
+
+    def _apply(self, chromo: np.ndarray) -> None:
+        for (path, tune), val in zip(self.tunes, chromo):
+            self.config_root.set_by_path(path, float(val))
+
+    def _fitness(self, chromo: np.ndarray) -> float:
+        self._apply(chromo)
+        return float(self.evaluate())
+
+    # -- GA operators ----------------------------------------------------------
+
+    def _tournament(self, scored) -> np.ndarray:
+        k = min(3, len(scored))
+        picks = self.rng.choice(len(scored), size=k, replace=False)
+        best = min(picks, key=lambda i: scored[i][1])
+        return scored[best][0]
+
+    def _crossover(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        alpha = self.rng.uniform(0.0, 1.0, size=a.shape)
+        return alpha * a + (1.0 - alpha) * b
+
+    def _mutate(self, c: np.ndarray) -> np.ndarray:
+        c = c.copy()
+        for i, (_, t) in enumerate(self.tunes):
+            if self.rng.random() < self.mutation_rate:
+                span = t.max - t.min
+                c[i] = np.clip(c[i] + self.rng.normal(0, 0.15 * span),
+                               t.min, t.max)
+        return c
+
+    # -- main loop -------------------------------------------------------------
+
+    def run(self) -> Tuple[np.ndarray, float]:
+        # population entries are (chromo, fitness|None); elites carry their
+        # fitness forward so a full workflow run is never repeated for an
+        # unchanged chromosome
+        pop = [(self._default_chromo(), None)]
+        while len(pop) < self.population_size:
+            pop.append((self._random_chromo(), None))
+        for gen in range(self.generations):
+            scored = [(c, f if f is not None else self._fitness(c))
+                      for c, f in pop]
+            scored.sort(key=lambda cf: cf[1])
+            if scored[0][1] < self.best_fitness:
+                self.best_fitness = scored[0][1]
+                self.best_chromo = scored[0][0].copy()
+            self.history.append(scored[0][1])
+            nxt = [(c.copy(), f) for c, f in scored[:self.elite]]
+            while len(nxt) < self.population_size:
+                child = self._crossover(self._tournament(scored),
+                                        self._tournament(scored))
+                nxt.append((self._mutate(child), None))
+            pop = nxt
+        self._apply(self.best_chromo)     # leave config at the winner
+        return self.best_chromo, self.best_fitness

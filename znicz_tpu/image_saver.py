@@ -1,0 +1,74 @@
+"""ImageSaver (rebuild of ``znicz/image_saver.py``): dumps misclassified
+samples as PNGs each epoch, named ``<dir>/<epoch>/<true>_as_<pred>_<i>.png``
+— the reference's worst-sample debugging artifact.  Linked after the
+evaluator; collects this minibatch's misclassifications (host side, capped)
+and flushes at epoch end."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.units import Unit
+
+root.common.dirs.defaults({"image_saver": "saved_images"})
+
+
+class ImageSaver(Unit):
+    def __init__(self, workflow=None, name=None, limit=32, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.limit = int(limit)
+        # linked attrs:
+        self.input = None             # minibatch_data (Array)
+        self.labels = None            # minibatch_labels (Array)
+        self.output = None            # softmax probs (Array)
+        self.batch_size = 0           # minibatch_size
+        self.epoch_number = 0
+        self.last_minibatch = False
+        self._pending: List[tuple] = []
+
+    def directory(self) -> str:
+        d = os.path.join(root.common.dirs.get("image_saver", "saved_images"),
+                         f"epoch_{int(self.epoch_number)}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run(self):
+        if len(self._pending) < self.limit:
+            probs = np.asarray(self.output.map_read())
+            labels = np.asarray(self.labels.map_read())
+            data = np.asarray(self.input.map_read())
+            pred = probs.argmax(-1)
+            n = int(self.batch_size)
+            wrong = np.nonzero((pred[:n] != labels[:n]))[0]
+            for i in wrong[:self.limit - len(self._pending)]:
+                self._pending.append((data[i].copy(), int(labels[i]),
+                                      int(pred[i])))
+        if self.last_minibatch and self._pending:
+            self.flush()
+
+    def flush(self):
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        d = self.directory()
+        for i, (img, true, pred) in enumerate(self._pending):
+            img = np.asarray(img, np.float32)
+            if img.ndim == 1:
+                side = int(np.sqrt(img.size))
+                img = img[:side * side].reshape(side, side)
+            if img.ndim == 3 and img.shape[-1] == 1:
+                img = img[..., 0]
+            lo, hi = float(img.min()), float(img.max())
+            if hi > lo:
+                img = (img - lo) / (hi - lo)
+            plt.imsave(os.path.join(d, f"{true}_as_{pred}_{i}.png"), img,
+                       cmap=None if img.ndim == 3 else "gray")
+        self.info("saved %d misclassified images -> %s",
+                  len(self._pending), d)
+        self._pending.clear()

@@ -116,8 +116,7 @@ class KohonenTrainer(Unit, KohonenBase):
         self.radius0 = float(radius if radius is not None
                              else max(self.sy, self.sx) / 2.0)
         self.decay_epochs = float(decay_epochs)
-        self.time = 0                          # epochs elapsed (linked or set)
-        self.epoch_number = 0                  # link from loader
+        self.epoch_number = 0                  # link from loader; drives decay
         #: mean squared quantization error of the last minibatch
         self.qerror = 0.0
         self._coords = grid_coords(self.sy, self.sx)

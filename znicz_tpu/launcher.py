@@ -1,0 +1,109 @@
+"""Launcher + CLI (rebuild of ``veles/launcher.py`` / ``veles/__main__.py``,
+SURVEY.md §3.1).
+
+Reference surface preserved::
+
+    python -m znicz_tpu <workflow.py|module> [config.py]
+        [root.path.key=value ...] [--snapshot FILE] [--backend cpu|tpu]
+        [--workflow-graph FILE.dot] [--list]
+
+A workflow script is any python file/module exposing ``run(snapshot=...,
+device=...) -> workflow`` (all the bundled samples do); a config file is any
+python file mutating ``znicz_tpu.core.config.root`` (applied before the
+workflow module loads, then CLI dotted overrides on top — reference
+precedence).  The reference's ``--master``/``--slave`` flags have no
+equivalent: distribution is SPMD inside the jitted step (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+from znicz_tpu.core.config import apply_overrides, root
+from znicz_tpu.core.logger import setup_logging
+
+SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet")
+
+
+def _load_module(spec: str, tag: str):
+    if os.path.exists(spec):
+        mod_spec = importlib.util.spec_from_file_location(tag, spec)
+        mod = importlib.util.module_from_spec(mod_spec)
+        sys.modules[tag] = mod
+        mod_spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(spec)
+
+
+class Launcher:
+    def __init__(self, argv: Optional[List[str]] = None):
+        parser = argparse.ArgumentParser(
+            prog="znicz_tpu",
+            description="TPU-native VELES/Znicz workflow launcher")
+        parser.add_argument("workflow", nargs="?",
+                            help="workflow .py file, module path, or bundled "
+                                 f"sample name ({', '.join(SAMPLES)})")
+        parser.add_argument("config", nargs="?",
+                            help="optional config .py file (mutates root)")
+        parser.add_argument("overrides", nargs="*",
+                            help="dotted overrides: root.a.b=value")
+        parser.add_argument("--snapshot", default="",
+                            help="resume from a snapshot file")
+        parser.add_argument("--backend", default=None,
+                            help="jax platform: tpu/cpu (default auto)")
+        parser.add_argument("--seed", type=int, default=None)
+        parser.add_argument("--workflow-graph", default="",
+                            help="write the control graph as graphviz dot")
+        parser.add_argument("--list", action="store_true",
+                            help="list bundled samples")
+        self.args = parser.parse_args(argv)
+
+    def run(self) -> int:
+        setup_logging()
+        args = self.args
+        if args.list or not args.workflow:
+            print("bundled samples:", ", ".join(SAMPLES))
+            return 0
+        # argparse can't distinguish "config.py" from the first dotted
+        # override positionally — reclassify by the "=" marker
+        if args.config and "=" in args.config:
+            args.overrides.insert(0, args.config)
+            args.config = None
+        if args.backend:
+            root.common.engine.backend = args.backend
+        if args.seed is not None:
+            from znicz_tpu.core import prng
+
+            prng.seed_all(args.seed)
+        if args.config:
+            _load_module(args.config, "znicz_tpu._user_config")
+        if args.overrides:
+            apply_overrides(root, args.overrides)
+        spec = args.workflow
+        if spec in SAMPLES:
+            spec = f"znicz_tpu.samples.{spec}"
+        mod = _load_module(spec, "znicz_tpu._user_workflow")
+        if not hasattr(mod, "run"):
+            print(f"error: {spec} does not expose run()", file=sys.stderr)
+            return 2
+        import inspect
+
+        kwargs = {}
+        sig = inspect.signature(mod.run)
+        if "snapshot" in sig.parameters and args.snapshot:
+            kwargs["snapshot"] = args.snapshot
+        wf = mod.run(**kwargs)
+        if args.workflow_graph and wf is not None:
+            with open(args.workflow_graph, "w") as f:
+                f.write(wf.generate_graph())
+            print(f"workflow graph -> {args.workflow_graph}")
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return Launcher(argv).run()

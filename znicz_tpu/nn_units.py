@@ -142,6 +142,20 @@ def _decay_grad(w, weights_decay, l1_vs_l2):
                             + (1.0 - l1_vs_l2) * w)
 
 
+def sgd_update(w, g, v, *, lr, weights_decay, l1_vs_l2, momentum, clip):
+    """The reference's weight-update kernel as one pure function — the
+    SINGLE home of the update rule, used by both the unit-at-a-time GD units
+    and the fused SPMD trainer (they must never drift).
+
+    Returns (w_new, v_new)."""
+    import jax.numpy as jnp
+
+    g = jnp.where(clip > 0.0, jnp.clip(g, -clip, clip), g)
+    g = g + _decay_grad(w, weights_decay, l1_vs_l2)
+    v_new = momentum * v - lr * g
+    return w + v_new, v_new
+
+
 class GradientDescentBase(Unit):
     """Backward twin of a ``ForwardBase``: consumes ``err_output``, produces
     ``err_input`` and updates the forward's params in place (on device).
@@ -190,23 +204,20 @@ class GradientDescentBase(Unit):
         """Pure: one backward+update step.  Returns (err_input, new_params,
         new_velocities)."""
         import jax
-        import jax.numpy as jnp
 
         (lr, lr_bias, wd, wd_bias, l1l2, mom, mom_bias, clip) = hypers
         _, vjp = jax.vjp(self.backward_apply, params, x)
         grads, err_input = vjp(err_output)
         new_params, new_vel = {}, {}
         for k, g in grads.items():
-            w = params[k]
             is_bias = (k == "bias")
-            k_lr = lr_bias if is_bias else lr
-            k_wd = wd_bias if is_bias else wd
-            k_mom = mom_bias if is_bias else mom
-            g = jnp.where(clip > 0.0, jnp.clip(g, -clip, clip), g)
-            g = g + _decay_grad(w, k_wd, l1l2)
-            v = k_mom * velocities[k] - k_lr * g
-            new_vel[k] = v
-            new_params[k] = w + v
+            new_params[k], new_vel[k] = sgd_update(
+                params[k], g, velocities[k],
+                lr=(lr_bias if is_bias else lr),
+                weights_decay=(wd_bias if is_bias else wd),
+                l1_vs_l2=l1l2,
+                momentum=(mom_bias if is_bias else mom),
+                clip=clip)
         return err_input, new_params, new_vel
 
     # -- unit lifecycle ------------------------------------------------------

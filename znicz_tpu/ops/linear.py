@@ -21,8 +21,12 @@ def linear(x, w, b=None, *, weights_transposed: bool = False,
     if compute_dtype is not None:
         x2 = x2.astype(compute_dtype)
         w = w.astype(compute_dtype)
+    # bf16 operands keep a bf16 output (the MXU still accumulates f32
+    # internally); forcing an f32 output would make the vjp cotangents f32
+    # against bf16 weights and break mixed-precision backward convs/dots.
+    pref = jnp.float32 if x2.dtype == jnp.float32 else None
     y = jnp.dot(x2, w if weights_transposed else w.T,
-                preferred_element_type=jnp.float32)
+                preferred_element_type=pref)
     if b is not None:
         y = y + b
     return y

@@ -1,0 +1,2 @@
+from znicz_tpu.parallel.mesh import make_mesh  # noqa: F401
+from znicz_tpu.parallel.fused import FusedTrainer  # noqa: F401

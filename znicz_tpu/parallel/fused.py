@@ -1,0 +1,373 @@
+"""FusedTrainer: the TPU-native fast path — one jitted SPMD train step for a
+StandardWorkflow-shaped graph.
+
+The unit-at-a-time engine (Workflow.run) preserves the reference's execution
+semantics but pays one dispatch + host sync per unit.  The fused trainer
+stages the whole minibatch pipeline
+
+    gather(dataset, idx) -> forwards -> loss -> grads -> per-layer sgd_update
+
+into ONE ``jax.jit`` with sharding annotations: dataset/batch sharded over
+the mesh ``data`` axis, params replicated (or column-sharded over ``model``
+for wide FC layers), gradients reduced by the psum XLA inserts — the
+reference's entire master/slave ZeroMQ stack (SURVEY.md §3.4) becomes a
+single compiled collective over ICI.
+
+Semantics guaranteed identical to the unit path:
+  - forward math IS the units' own pure ``apply`` (same code objects);
+  - the update rule IS ``nn_units.sgd_update`` with each GD unit's own
+    hyperparameters (per-layer lr/momentum/L1+L2/clip survive);
+  - loss/cotangent match the evaluators (softmax-CE at logits; masked MSE);
+  - dropout/stochastic pooling draw per-layer per-step keys from the same
+    seeded stream design (mask reuse is implicit — fwd and bwd live in one
+    autodiff graph).
+
+Mixed precision: with ``root.common.engine.precision = "bfloat16"``, the
+forward/backward graph runs in bf16 on the MXU while master params, velocity
+and the update stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.nn_units import sgd_update
+
+
+class FusedTrainer:
+    """Compile and drive fused steps for a built+initialized workflow with
+    ``forwards``, ``gds``, ``loader``, ``evaluator``, ``decision``."""
+
+    def __init__(self, workflow, mesh=None):
+        from znicz_tpu.all2all import All2AllSoftmax
+        from znicz_tpu.dropout import DropoutForward
+        from znicz_tpu.evaluator import EvaluatorSoftmax
+        from znicz_tpu.pooling import StochasticPoolingBase
+
+        self.workflow = workflow
+        self.forwards = list(workflow.forwards)
+        self.loader = workflow.loader
+        self.decision = workflow.decision
+        self.mesh = mesh
+        self.loss_kind = ("softmax"
+                          if isinstance(workflow.evaluator, EvaluatorSoftmax)
+                          else "mse")
+        self._softmax_cls = All2AllSoftmax
+        self._dropout_cls = DropoutForward
+        self._stochpool_cls = StochasticPoolingBase
+        self.gd_of = {gd.forward.name: gd for gd in workflow.gds}
+        # tied weights (shared Arrays) need joint-update logic the fused
+        # path doesn't implement — detect and refuse (unit path handles it)
+        seen = {}
+        for f in self.forwards:
+            for k, arr in f.params().items():
+                if id(arr) in seen:
+                    raise ValueError(
+                        f"fused trainer does not support tied weights "
+                        f"({f.name}.{k} shares {seen[id(arr)]})")
+                seen[id(arr)] = f"{f.name}.{k}"
+        self._train_step = None
+        self._eval_step = None
+        self._key0 = prng.get("fused_trainer").jax_key(0)
+        self.steps_done = 0
+        self.compute_dtype = (np.dtype("float32")
+                              if root.common.engine.get("precision",
+                                                        "float32")
+                              == "float32" else "bfloat16")
+
+    # -- state extraction ------------------------------------------------------
+
+    def extract_params(self) -> Dict[str, Dict[str, object]]:
+        return {f.name: {k: a.devmem for k, a in f.params().items()}
+                for f in self.forwards if f.has_weights}
+
+    def extract_velocities(self):
+        out = {}
+        for f in self.forwards:
+            gd = self.gd_of.get(f.name)
+            if gd is not None and f.has_weights:
+                out[f.name] = {k: a.devmem
+                               for k, a in gd._velocities.items()}
+        return out
+
+    def hypers(self):
+        out = {}
+        for f in self.forwards:
+            gd = self.gd_of.get(f.name)
+            if gd is not None and f.has_weights:
+                out[f.name] = tuple(np.float32(v) for v in (
+                    gd.learning_rate, gd.learning_rate_bias,
+                    gd.weights_decay, gd.weights_decay_bias, gd.l1_vs_l2,
+                    gd.gradient_moment, gd.gradient_moment_bias,
+                    gd.gradient_clip))
+        return out
+
+    def writeback(self, params, velocities) -> None:
+        """Push fused-step results back into the unit Arrays (snapshotter /
+        plotters / unit-mode interop see the same state)."""
+        for f in self.forwards:
+            if f.has_weights:
+                for k, a in f.params().items():
+                    a.devmem = params[f.name][k]
+                gd = self.gd_of.get(f.name)
+                if gd is not None:
+                    for k, a in gd._velocities.items():
+                        a.devmem = velocities[f.name][k]
+
+    # -- the pure step ---------------------------------------------------------
+
+    def forward_pass(self, params, x, key, train: bool, cast=None):
+        """Compose the units' pure applies; returns the last unit's output
+        (LOGITS for a softmax last layer — loss and probs both derive from
+        them, matching the evaluator's math).  ``cast`` re-casts activations
+        between layers in mixed precision (matmul/conv accumulate f32 via
+        preferred_element_type, outputs drop back to bf16)."""
+        import jax
+
+        from znicz_tpu.ops.linear import linear
+
+        h = x
+        last = self.forwards[-1]
+        for i, f in enumerate(self.forwards):
+            if cast is not None:
+                h = cast(h)
+            p = params.get(f.name, {})
+            if isinstance(f, self._dropout_cls):
+                if train:
+                    k = jax.random.fold_in(key, i)
+                    m = f.make_mask(k, h.shape, f.dropout_ratio)
+                    h = h * m
+                # eval: identity
+            elif isinstance(f, self._stochpool_cls):
+                win = f.windows(h)
+                if train:
+                    k = jax.random.fold_in(key, i)
+                    h, _ = f._select_stochastic(win, k)
+                else:
+                    h, _ = f._select_expected(win)
+            elif f is last and isinstance(f, self._softmax_cls):
+                h = linear(h, p["weights"], p.get("bias"),
+                           weights_transposed=f.weights_transposed)
+                h = h.reshape((x.shape[0],) + f.output_sample_shape)
+            else:
+                h = f.apply(p, h)
+        return h
+
+    def loss_and_metrics(self, params, data, target, batch_size, key,
+                         train: bool):
+        import jax.numpy as jnp
+
+        import jax
+
+        if self.compute_dtype == np.dtype("float32"):
+            cast = None
+            cparams = params
+            out = self.forward_pass(cparams, data, key, train)
+        else:
+            def cast(t):
+                return t.astype("bfloat16") if t.dtype == jnp.float32 else t
+
+            cparams = jax.tree_util.tree_map(cast, params)
+            out = self.forward_pass(cparams, cast(data), key, train,
+                                    cast=cast)
+        out = out.astype("float32")
+        n = out.shape[0]
+        valid = (jnp.arange(n) < batch_size)
+        denom = jnp.maximum(batch_size, 1)
+        if self.loss_kind == "softmax":
+            logits = out
+            labels = target
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+            loss = jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
+            pred = jnp.argmax(logits, axis=-1)
+            n_err = jnp.sum((pred != labels) & valid)
+            n_classes = logits.shape[-1]
+            conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+                pred, labels].add(valid.astype(jnp.int32))
+            return loss, (loss, n_err, conf)
+        else:
+            y = out.reshape(n, -1)
+            t = target.reshape(n, -1)
+            diff = (y - t) * valid[:, None]
+            loss = 0.5 * jnp.sum(jnp.square(diff)) / denom
+            return loss, (loss, jnp.int32(0), jnp.zeros((1, 1), jnp.int32))
+
+    #: FC layers at least this wide get tensor-parallel row sharding when
+    #: the mesh has a ``model`` axis (AlexNet's 4096-wide fc6/fc7)
+    tp_threshold = 1024
+
+    def param_sharding(self, name, k, arr):
+        """Per-param placement: wide (out, in) FC weights shard their output
+        rows over the ``model`` axis (and the matching bias over ``model``);
+        everything else replicates.  XLA/GSPMD propagates the activation
+        shardings and inserts the collectives."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        if ("model" in mesh.axis_names
+                and mesh.shape["model"] > 1
+                and int(arr.shape[0]) >= self.tp_threshold
+                and int(arr.shape[0]) % mesh.shape["model"] == 0):
+            if getattr(arr, "ndim", len(arr.shape)) == 2:
+                return NamedSharding(mesh, P("model", None))
+            if getattr(arr, "ndim", len(arr.shape)) == 1:
+                return NamedSharding(mesh, P("model"))
+        return NamedSharding(mesh, P())
+
+    def make_train_step(self):
+        """The step takes ``hypers`` as a traced argument so per-epoch lr
+        adjustment (LearningRateAdjust) never recompiles."""
+        import jax
+
+        def step(params, velocities, hypers, dataset, targets, idx,
+                 batch_size, key):
+            data = jax.numpy.take(dataset, idx, axis=0)
+            tgt = jax.numpy.take(targets, idx, axis=0)
+            if self.mesh is not None:
+                # dataset stays replicated; the gathered minibatch is what
+                # shards over the data axis (XLA then keeps the whole
+                # fwd/bwd batch-sharded and psums the grads over ICI)
+                from znicz_tpu.parallel.mesh import data_sharding
+
+                shard = data_sharding(self.mesh)
+                data = jax.lax.with_sharding_constraint(data, shard)
+                tgt = jax.lax.with_sharding_constraint(tgt, shard)
+
+            def lf(p):
+                return self.loss_and_metrics(p, data, tgt, batch_size, key,
+                                             train=True)
+
+            grads, metrics = jax.grad(lf, has_aux=True)(params)
+            new_p, new_v = {}, {}
+            for name, layer_p in params.items():
+                lr, lrb, wd, wdb, l1l2, mom, momb, clip = hypers[name]
+                new_p[name], new_v[name] = {}, {}
+                for k, w in layer_p.items():
+                    g = grads[name][k].astype("float32")
+                    is_bias = (k == "bias")
+                    new_p[name][k], new_v[name][k] = sgd_update(
+                        w, g, velocities[name][k],
+                        lr=(lrb if is_bias else lr),
+                        weights_decay=(wdb if is_bias else wd),
+                        l1_vs_l2=l1l2,
+                        momentum=(momb if is_bias else mom), clip=clip)
+            return new_p, new_v, metrics
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def make_eval_step(self):
+        """Metrics-only step.  ``train`` is static: True replays the exact
+        train-mode forward (dropout/stochastic masks from the same key) —
+        used at epoch tails to let the Decision rule on this minibatch's
+        metrics BEFORE the update is adopted, matching the unit path where
+        gd_skip gates the final update off once ``complete`` flips."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(6,))
+        def step(params, dataset, targets, idx, batch_size, key, train):
+            data = jax.numpy.take(dataset, idx, axis=0)
+            tgt = jax.numpy.take(targets, idx, axis=0)
+            _, metrics = self.loss_and_metrics(
+                params, data, tgt, batch_size, key, train=train)
+            return metrics
+
+        return step
+
+    # -- the epoch driver ------------------------------------------------------
+
+    def run(self) -> None:
+        """Train until the decision completes, mirroring the loader's
+        epoch/class state machine but with fused steps.  Feeds the Decision
+        unit per-minibatch so its improvement/stop/log semantics (and the
+        snapshotter trigger) behave exactly like the unit path."""
+        from znicz_tpu.loader.base import TRAIN
+
+        wf = self.workflow
+        loader, decision = self.loader, self.decision
+        if self._train_step is None:
+            self._train_step = self.make_train_step()
+            self._eval_step = self.make_eval_step()
+        params = self.extract_params()
+        velocities = self.extract_velocities()
+        dataset = loader.original_data.devmem
+        if self.loss_kind == "softmax":
+            targets = loader.original_labels.devmem
+        else:
+            targets = loader.original_targets.devmem
+        repl = None
+        if self.mesh is not None:
+            import jax
+            from znicz_tpu.parallel.mesh import replicated
+
+            repl = replicated(self.mesh)
+            params = {name: {k: jax.device_put(
+                a, self.param_sharding(name, k, a))
+                for k, a in layer.items()}
+                for name, layer in params.items()}
+            velocities = {name: {k: jax.device_put(
+                a, self.param_sharding(name, k, a))
+                for k, a in layer.items()}
+                for name, layer in velocities.items()}
+            dataset = jax.device_put(dataset, repl)
+            targets = jax.device_put(targets, repl)
+
+        def feed_decision(metrics):
+            loss, n_err, conf = metrics
+            decision.minibatch_class = loader.minibatch_class
+            decision.last_minibatch = loader.last_minibatch
+            decision.class_ended = loader.class_ended
+            decision.epoch_number = loader.epoch_number
+            decision.class_lengths = loader.class_lengths
+            decision.minibatch_size = int(loader.minibatch_size)
+            decision.minibatch_loss = float(loss)
+            if hasattr(decision, "minibatch_n_err"):
+                decision.minibatch_n_err = int(n_err)
+                decision.confusion_matrix = np.asarray(conf)
+            decision.run()
+
+        while not bool(decision.complete):
+            loader.run()                       # advances the state machine
+            idx = loader.minibatch_indices.devmem
+            if repl is not None:
+                import jax
+                idx = jax.device_put(idx, repl)
+            bs = np.int32(loader.minibatch_size)
+            is_train = (loader.minibatch_class == TRAIN)
+            if is_train and not loader.last_minibatch:
+                # complete can only flip at the epoch tail -> update freely
+                key = prng.get("fused_trainer").jax_key(self.steps_done)
+                params, velocities, metrics = self._train_step(
+                    params, velocities, self.hypers(), dataset, targets,
+                    idx, bs, key)
+                self.steps_done += 1
+                feed_decision(metrics)
+            elif is_train:
+                # epoch tail: metrics first, Decision rules, and the update
+                # is applied only if gd_skip stayed open (unit-path parity)
+                key = prng.get("fused_trainer").jax_key(self.steps_done)
+                metrics = self._eval_step(params, dataset, targets, idx, bs,
+                                          key, True)
+                feed_decision(metrics)
+                if not bool(decision.gd_skip):
+                    params, velocities, _ = self._train_step(
+                        params, velocities, self.hypers(), dataset, targets,
+                        idx, bs, key)
+                self.steps_done += 1
+            else:
+                metrics = self._eval_step(params, dataset, targets, idx, bs,
+                                          self._key0, False)
+                feed_decision(metrics)
+            if bool(decision.epoch_ended):
+                self.writeback(params, velocities)
+                snap = getattr(wf, "snapshotter", None)
+                if snap is not None and not bool(snap.gate_skip):
+                    snap.epoch_number = decision.epoch_number
+                    snap.improved = decision.improved
+                    snap.run()
+        self.writeback(params, velocities)

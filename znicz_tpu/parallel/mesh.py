@@ -1,0 +1,70 @@
+"""Device mesh construction — the rebuild's replacement for the reference's
+master/slave topology (SURVEY.md §2.4): instead of a ZeroMQ star, an SPMD
+mesh of TPU chips with named axes:
+
+  - ``data``  — batch sharding (the reference's only strategy, made
+    synchronous: psum over ICI instead of async pickle-over-TCP);
+  - ``model`` — tensor-parallel sharding of wide FC layers (beyond-reference
+    capability, used by AlexNet's fc layers when the mesh has a model axis).
+
+Multi-host: call ``distributed_init()`` once per process before building the
+mesh; jax.distributed wires DCN and ``jax.devices()`` becomes global.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              axes: Sequence[str] = ("data",), devices=None):
+    """Build a Mesh over ``devices`` (default: all).  shape=None puts every
+    device on the first axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axes) - 1)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                         f"have {len(devs)}")
+    grid = np.asarray(devs[:n]).reshape(shape)
+    return Mesh(grid, tuple(axes))
+
+
+def data_sharding(mesh):
+    """Batch-dim sharding over the ``data`` axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def column_sharded(mesh):
+    """(out, in) weight sharded by output columns over ``model`` —
+    tensor parallelism for wide FC layers."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("model", None))
+
+
+def distributed_init(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up over DCN (the reference's master/slave handshake
+    collapses to jax.distributed).  No-op when single-process."""
+    import jax
+
+    if num_processes and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)

@@ -1,0 +1,162 @@
+"""Plotting units (rebuild of ``veles/plotting_units.py`` +
+``znicz/nn_plotting_units.py``).
+
+The reference streamed live matplotlib figures from plot units to a separate
+``GraphicsClient`` process over ZMQ pub/sub.  On a headless TPU host the
+rebuild renders the same figures *offline*: each plotter is an ordinary unit
+gated to epoch boundaries that writes a PNG under
+``root.common.dirs.plots`` (plus keeps the raw series on itself for tests /
+notebooks).  The figure set mirrors the reference: error curves
+(AccumulatingPlotter), weight tiles (Weights2D), confusion matrix
+(MatrixPlotter), SOM hit maps (KohonenHits), value histograms
+(MultiHistogram).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.units import Unit
+
+root.common.dirs.defaults({"plots": "plots"})
+
+
+def _plots_dir() -> str:
+    d = root.common.dirs.get("plots", "plots")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class Plotter(Unit):
+    """Base: renders into ``<plots>/<name>.png`` via headless matplotlib."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.render = kwargs.get("render", True)
+
+    def _figure(self):
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        return plt
+
+    def path(self) -> str:
+        return os.path.join(_plots_dir(), f"{self.name}.png")
+
+    def redraw(self, plt) -> None:
+        raise NotImplementedError
+
+    def run(self):
+        if not self.render:
+            return
+        plt = self._figure()
+        fig = plt.figure(figsize=(6, 4), dpi=96)
+        try:
+            self.redraw(plt)
+            fig.savefig(self.path(), bbox_inches="tight")
+        finally:
+            plt.close(fig)
+
+
+class AccumulatingPlotter(Plotter):
+    """Error/loss curve: appends ``input`` (a float, linked e.g. to a
+    decision epoch metric via a fetch callable) every run."""
+
+    def __init__(self, workflow=None, name=None, fetch=None, ylabel="value",
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.fetch = fetch                 # () -> float
+        self.ylabel = ylabel
+        self.values: List[float] = []
+
+    def run(self):
+        if self.fetch is not None:
+            self.values.append(float(self.fetch()))
+        super().run()
+
+    def redraw(self, plt):
+        plt.plot(self.values, marker="o", ms=3)
+        plt.xlabel("epoch")
+        plt.ylabel(self.ylabel)
+        plt.grid(True, alpha=0.3)
+
+
+class Weights2D(Plotter):
+    """Weight tiles: first ``limit`` rows of a weight matrix reshaped to
+    ``sample_shape`` and tiled into one image (the reference's
+    weights-as-images plot)."""
+
+    def __init__(self, workflow=None, name=None, source=None,
+                 sample_shape=None, limit=64, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.source = source               # Array (n_out, fan_in)
+        self.sample_shape = sample_shape   # e.g. (28, 28)
+        self.limit = int(limit)
+
+    def redraw(self, plt):
+        w = np.asarray(self.source.map_read())
+        w = w.reshape(w.shape[0], -1)[:self.limit]
+        shape = self.sample_shape or (
+            int(np.sqrt(w.shape[1])), int(np.sqrt(w.shape[1])))
+        n = w.shape[0]
+        cols = int(np.ceil(np.sqrt(n)))
+        rows = int(np.ceil(n / cols))
+        tile = np.zeros((rows * shape[0], cols * shape[1]), np.float32)
+        for i in range(n):
+            r, c = divmod(i, cols)
+            img = w[i][:shape[0] * shape[1]].reshape(shape)
+            tile[r * shape[0]:(r + 1) * shape[0],
+                 c * shape[1]:(c + 1) * shape[1]] = img
+        plt.imshow(tile, cmap="gray")
+        plt.axis("off")
+
+
+class MatrixPlotter(Plotter):
+    """Confusion matrix heatmap."""
+
+    def __init__(self, workflow=None, name=None, fetch=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.fetch = fetch                 # () -> 2D array
+
+    def redraw(self, plt):
+        m = np.asarray(self.fetch())
+        plt.imshow(m, cmap="viridis")
+        plt.colorbar()
+        plt.xlabel("target")
+        plt.ylabel("predicted")
+
+
+class KohonenHits(Plotter):
+    """SOM hit map: per-neuron winner counts on the (sy, sx) grid."""
+
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.forward = forward             # KohonenForward
+
+    def redraw(self, plt):
+        f = self.forward
+        hits = np.asarray(f.hits.map_read()).reshape(f.sy, f.sx)
+        plt.imshow(hits, cmap="hot")
+        plt.colorbar()
+        plt.title(f"hits (total {f.total})")
+
+
+class MultiHistogram(Plotter):
+    """Histogram of a tensor's values (weights diversity diagnostics)."""
+
+    def __init__(self, workflow=None, name=None, source=None, bins=50,
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.source = source
+        self.bins = int(bins)
+
+    def redraw(self, plt):
+        vals = np.asarray(self.source.map_read()).reshape(-1)
+        plt.hist(vals, bins=self.bins)
+        plt.grid(True, alpha=0.3)
