@@ -114,3 +114,19 @@ def test_mean_disp_normalizer_roundtrip():
     d3 = data.copy()
     norm2.apply_inplace(d3)
     np.testing.assert_allclose(d2, d3)
+
+
+def test_native_shuffle_path():
+    from znicz_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native lib unavailable")
+    ld = make_loader(native_shuffle=True)
+    idx = []
+    for _ in range(6):
+        ld.run()
+        if ld.minibatch_class == TRAIN:
+            idx.extend(np.array(ld.minibatch_indices.mem)
+                       [:ld.minibatch_size].tolist())
+    assert sorted(idx) == list(range(10, 20))

@@ -1,0 +1,147 @@
+"""Extended loaders: image dirs, pickles, HDF5, minibatch saver/replay,
+ZeroMQ feed."""
+
+import gzip
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from znicz_tpu.loader.base import TRAIN, VALID
+
+
+def _write_images(base, classes=("cat", "dog"), per_class=3, size=(8, 8)):
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    for cname in classes:
+        d = os.path.join(base, cname)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, size=size + (3,), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+
+
+def test_image_loader(tmp_path):
+    from znicz_tpu.loader.image import FullBatchFileImageLoader
+
+    train = tmp_path / "train"
+    valid = tmp_path / "valid"
+    _write_images(str(train), per_class=4)
+    _write_images(str(valid), per_class=2)
+    ld = FullBatchFileImageLoader(
+        name="imgld", train_path=str(train), valid_path=str(valid),
+        target_shape=(8, 8), minibatch_size=4)
+    ld.initialize(device=None)
+    assert ld.class_lengths == [0, 4, 8]
+    assert ld.class_names == ["cat", "dog"]
+    assert ld.original_data.shape == (12, 8, 8, 3)
+    assert 0.0 <= ld.original_data.mem.min()
+    assert ld.original_data.mem.max() <= 1.0
+    ld.run()
+    assert ld.minibatch_class == VALID
+    assert ld.minibatch_size == 4
+
+
+def test_pickles_loader(tmp_path):
+    from znicz_tpu.loader.pickles import FullBatchPicklesLoader
+
+    rng = np.random.default_rng(2)
+    train = (rng.normal(size=(10, 4)).astype(np.float32),
+             rng.integers(0, 3, size=10).astype(np.int32))
+    with gzip.open(tmp_path / "train.pickle.gz", "wb") as f:
+        pickle.dump({"data": train[0], "labels": train[1]}, f)
+    ld = FullBatchPicklesLoader(
+        name="pkld", train_pickle=str(tmp_path / "train.pickle.gz"),
+        minibatch_size=5)
+    ld.initialize(device=None)
+    assert ld.class_lengths == [0, 0, 10]
+    np.testing.assert_allclose(ld.original_data.mem, train[0])
+
+
+def test_hdf5_loader(tmp_path):
+    import h5py
+
+    from znicz_tpu.loader.hdf5 import HDF5Loader
+
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "d.h5")
+    with h5py.File(path, "w") as f:
+        f["data"] = rng.normal(size=(12, 5)).astype(np.float32)
+        f["labels"] = rng.integers(0, 2, size=12).astype(np.int32)
+        f["class_lengths"] = np.array([0, 4, 8])
+    ld = HDF5Loader(name="h5ld", file_path=path, minibatch_size=4)
+    ld.initialize(device=None)
+    assert ld.class_lengths == [0, 4, 8]
+
+
+def test_minibatch_saver_and_replay(tmp_path):
+    from znicz_tpu.loader.fullbatch import FullBatchLoader
+    from znicz_tpu.loader.saver import MinibatchesLoader, MinibatchesSaver
+
+    ld = FullBatchLoader(name="svld", minibatch_size=4)
+    ld.original_data.mem = np.arange(24, dtype=np.float32).reshape(8, 3)
+    ld.original_labels.mem = np.arange(8, dtype=np.int32)
+    ld.class_lengths = [0, 0, 8]
+    ld.initialize(device=None)
+    path = str(tmp_path / "mb.pgz")
+    sv = MinibatchesSaver(name="sv", file_path=path)
+    sv.minibatch_data = ld.minibatch_data
+    sv.minibatch_labels = ld.minibatch_labels
+    sv.initialize(device=None)
+    served = []
+    for _ in range(2):
+        ld.run()
+        sv.minibatch_class = ld.minibatch_class
+        sv.minibatch_size = ld.minibatch_size
+        sv.run()
+        served.append(np.array(ld.minibatch_data.map_read()).copy())
+    sv.stop()
+
+    rp = MinibatchesLoader(name="rp", file_path=path)
+    rp.initialize(device=None)
+    assert rp.class_lengths == [0, 0, 8]
+    rp.run()
+    np.testing.assert_allclose(np.array(rp.minibatch_data.map_read()),
+                               served[0])
+    rp.run()
+    assert rp.last_minibatch
+    rp.run()                                 # wraps to next epoch
+    assert rp.epoch_number == 1
+    np.testing.assert_allclose(np.array(rp.minibatch_data.map_read()),
+                               served[0])
+
+
+def test_zmq_loader():
+    import zmq
+
+    from znicz_tpu.loader.zmq_loader import ZeroMQLoader
+
+    endpoint = "tcp://127.0.0.1:17755"
+    ld = ZeroMQLoader(name="zmqld", endpoint=endpoint, bind=True)
+    ld.initialize(device=None)
+
+    def feeder():
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.PUSH)
+        sock.connect(endpoint)
+        rec = {"data": np.ones((2, 3), np.float32),
+               "labels": np.array([0, 1], np.int32),
+               "class": TRAIN, "size": 2, "last": True}
+        sock.send(pickle.dumps(rec))
+        sock.send(pickle.dumps({"end": True}))
+        sock.close(0)
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    ld.run()
+    assert ld.minibatch_size == 2
+    assert ld.last_minibatch
+    np.testing.assert_allclose(np.array(ld.minibatch_data.map_read()),
+                               np.ones((2, 3)))
+    ld.run()
+    assert ld.finished
+    t.join()
+    ld.stop()

@@ -1,0 +1,61 @@
+"""C++ native host runtime: build, bindings, numerics."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import native
+
+
+def test_native_builds_and_loads():
+    assert native.build() is not None, "g++ build failed"
+    assert native.available()
+
+
+def test_xorshift_uniform_normal():
+    rng = native.XorShift128P(42)
+    u = np.zeros(10000, np.float32)
+    rng.fill_uniform(u, -1.0, 1.0)
+    assert -1.0 <= u.min() and u.max() < 1.0
+    assert abs(u.mean()) < 0.05
+    n = np.zeros(10000, np.float32)
+    rng.fill_normal(n, 2.0)
+    assert abs(n.mean()) < 0.1
+    assert abs(n.std() - 2.0) < 0.1
+
+
+def test_xorshift_deterministic():
+    a = native.XorShift128P(7)
+    b = native.XorShift128P(7)
+    ua = np.zeros(100, np.float32)
+    ub = np.zeros(100, np.float32)
+    a.fill_uniform(ua, 0, 1)
+    b.fill_uniform(ub, 0, 1)
+    np.testing.assert_array_equal(ua, ub)
+    c = native.XorShift128P(8)
+    uc = np.zeros(100, np.float32)
+    c.fill_uniform(uc, 0, 1)
+    assert not np.array_equal(ua, uc)
+
+
+def test_native_shuffle_is_permutation():
+    rng = native.XorShift128P(3)
+    arr = np.arange(1000, dtype=np.int32)
+    orig = arr.copy()
+    rng.shuffle(arr)
+    assert not np.array_equal(arr, orig)
+    assert np.array_equal(np.sort(arr), orig)
+
+
+def test_native_gather_matches_numpy():
+    rng = np.random.default_rng(5)
+    src = rng.normal(size=(50, 7)).astype(np.float32)
+    idx = rng.integers(0, 50, size=20).astype(np.int32)
+    got = native.gather_f32(src, idx)
+    np.testing.assert_array_equal(got, src[idx])
+
+
+def test_native_u8_to_f32():
+    src = np.arange(256, dtype=np.uint8)
+    got = native.u8_to_f32(src)
+    np.testing.assert_allclose(got, src.astype(np.float32) / 255.0,
+                               rtol=1e-6)

@@ -1,0 +1,269 @@
+"""L9 services: web status, forge, publishing, ensemble, misc units,
+distributable protocol, resizable FC, interaction shell."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+from znicz_tpu.memory import Array
+
+
+def _tiny_trained_mnist(tmp_path, epochs=1):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng._streams.clear()
+    prng.seed_all(1013)
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = epochs
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    wf.run()
+    return wf
+
+
+def test_web_status(tmp_path):
+    from znicz_tpu.web_status import WebStatus
+
+    wf = _tiny_trained_mnist(tmp_path)
+    status = WebStatus(port=0).start()
+    try:
+        status.register(wf)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            snap = json.load(r)
+        assert snap["workflows"][0]["name"] == "MnistWorkflow"
+        assert snap["workflows"][0]["complete"] is True
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/") as r:
+            page = r.read().decode()
+        assert "MnistWorkflow" in page
+    finally:
+        status.stop()
+
+
+def test_forge_roundtrip(tmp_path):
+    from znicz_tpu import snapshotter
+    from znicz_tpu.forge import Forge
+
+    wf = _tiny_trained_mnist(tmp_path)
+    forge = Forge(registry=str(tmp_path / "registry"))
+    forge.upload(wf, "mnist-mlp", metadata={"acc": 0.9})
+    entries = forge.list()
+    assert entries[0]["name"] == "mnist-mlp"
+    snap = forge.download("mnist-mlp")
+    w0 = np.array(wf.forwards[0].weights.map_read())
+    np.testing.assert_allclose(snap["units"]["fwd0"]["weights"], w0)
+    forge.delete("mnist-mlp")
+    assert forge.list() == []
+
+
+def test_publishing(tmp_path):
+    from znicz_tpu.publishing import publish
+
+    wf = _tiny_trained_mnist(tmp_path)
+    path = publish(wf, backend="markdown", directory=str(tmp_path / "rep"))
+    text = open(path).read()
+    assert "Training report" in text
+    assert "best_metric" in text
+    path2 = publish(wf, backend="html", directory=str(tmp_path / "rep"))
+    assert open(path2).read().startswith("<html>")
+
+
+def test_ensemble(tmp_path):
+    from znicz_tpu.ensemble import EnsembleEvaluator, EnsembleTrainer
+    from znicz_tpu.samples import mnist
+
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 1
+    root.common.dirs.snapshots = str(tmp_path)
+
+    def factory(seed):
+        wf = mnist.MnistWorkflow()
+        wf.initialize(device=None)
+        wf.run()
+        return wf
+
+    trainer = EnsembleTrainer(factory, n_models=2).run()
+    assert len(trainer.members) == 2
+    # member weights differ (different seeds)
+    w0 = np.array(trainer.members[0].forwards[0].weights.map_read())
+    w1 = np.array(trainer.members[1].forwards[0].weights.map_read())
+    assert not np.allclose(w0, w1)
+
+    from znicz_tpu import datasets
+    data, labels = datasets.digits(20, stream="dataset.ens")
+    ev = EnsembleEvaluator(trainer.members)
+    probs = ev.predict_proba(data.reshape(20, -1))
+    assert probs.shape == (20, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+    assert ev.n_err(data.reshape(20, -1), labels) <= 20
+
+
+def test_distributable_protocol():
+    from znicz_tpu.all2all import All2All
+
+    fwd = All2All(name="distfwd", output_sample_shape=(3,))
+    fwd.input = Array(np.ones((2, 4), np.float32))
+    fwd.initialize(device=None)
+    payload = fwd.generate_data_for_slave()
+    assert set(payload) == {"weights", "bias"}
+    fwd2 = All2All(name="distfwd2", output_sample_shape=(3,))
+    fwd2.input = Array(np.ones((2, 4), np.float32))
+    fwd2.initialize(device=None)
+    fwd2.apply_data_from_master(payload)
+    np.testing.assert_allclose(np.array(fwd2.weights.map_read()),
+                               payload["weights"])
+    up = fwd2.generate_data_for_master()
+    fwd.apply_data_from_slave(up)
+    np.testing.assert_allclose(np.array(fwd.weights.map_read()),
+                               up["weights"])
+
+
+def test_resizable_all2all():
+    from znicz_tpu.resizable_all2all import ResizableAll2All
+
+    fwd = ResizableAll2All(name="rsz", output_sample_shape=(4,))
+    fwd.input = Array(np.ones((2, 5), np.float32))
+    fwd.initialize(device=None)
+    fwd.run()
+    w_before = np.array(fwd.weights.map_read()).copy()
+    fwd.resize(7)
+    assert fwd.weights.shape == (7, 5)
+    np.testing.assert_allclose(np.array(fwd.weights.map_read())[:4],
+                               w_before)
+    fwd.run()
+    assert tuple(fwd.output.shape) == (2, 7)
+    fwd.resize(3)
+    fwd.run()
+    assert tuple(fwd.output.shape) == (2, 3)
+
+
+def test_zero_filler_and_rollback():
+    from znicz_tpu.all2all import All2All
+    from znicz_tpu.misc_units import NNRollback, ZeroFiller
+
+    fwd = All2All(name="zf_fwd", output_sample_shape=(3,))
+    fwd.input = Array(np.ones((2, 4), np.float32))
+    fwd.initialize(device=None)
+    mask = np.ones((3, 4), bool)
+    mask[0, :] = False
+    zf = ZeroFiller(name="zf")
+    zf.add_mask(fwd, mask)
+    zf.run()
+    assert np.all(np.array(fwd.weights.map_read())[0] == 0)
+
+    rb = NNRollback(name="rb", rollback_factor=2.0)
+    rb.watch(fwd)
+    rb.loss = 1.0
+    rb.run()                                  # records best
+    good = np.array(fwd.weights.map_read()).copy()
+    fwd.weights.map_write()[...] = 99.0
+    rb.loss = 10.0                            # diverged
+    rb.run()
+    np.testing.assert_allclose(np.array(fwd.weights.map_read()), good)
+    assert rb.rollbacks == 1
+
+
+def test_mean_disp_unit():
+    from znicz_tpu.misc_units import MeanDispNormalizerUnit
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(3.0, 2.0, size=(10, 6)).astype(np.float32)
+    unit = MeanDispNormalizerUnit(name="mdn")
+    unit.input = Array(x)
+    unit.mean.mem = x.mean(0)
+    unit.disp.mem = (x.max(0) - x.min(0))
+    unit.initialize(device=None)
+    unit.run()
+    got = np.array(unit.output.map_read())
+    want = (x - x.mean(0)) / (x.max(0) - x.min(0))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_shell_unit_noop():
+    from znicz_tpu.interaction import Shell
+
+    sh = Shell(name="shell", interactive=False)
+    sh.run()
+    assert sh.invocations == 1
+
+def test_forge_rejects_escaping_names(tmp_path):
+    from znicz_tpu.forge import Forge
+
+    forge = Forge(registry=str(tmp_path / "reg2"))
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        forge._pkg_dir("..")
+    with _pytest.raises(ValueError):
+        forge._pkg_dir(".")
+
+
+def test_gd_distributable_ships_velocities():
+    from znicz_tpu.all2all import All2All
+    from znicz_tpu.gd import GradientDescent
+
+    fwd = All2All(name="gdist_fwd", output_sample_shape=(2,))
+    fwd.input = Array(np.ones((2, 3), np.float32))
+    fwd.initialize(device=None)
+    gd = GradientDescent(name="gdist", forward=fwd, learning_rate=0.1,
+                         gradient_moment=0.9, need_err_input=False)
+    gd.err_output = Array(np.ones((2, 2), np.float32))
+    gd.initialize(device=None)
+    fwd.run(); gd.run()
+    payload = gd.generate_data_for_master()
+    assert set(payload) == {"weights", "bias"}
+    assert np.any(payload["weights"] != 0)
+    gd2 = GradientDescent(name="gdist2", forward=fwd, gradient_moment=0.9)
+    gd2.err_output = gd.err_output
+    gd2.initialize(device=None)
+    gd2.apply_data_from_master(payload)
+    np.testing.assert_allclose(
+        np.array(gd2._velocities["weights"].map_read()),
+        payload["weights"])
+
+
+def test_mean_disp_unit_refit_not_stale():
+    from znicz_tpu.misc_units import MeanDispNormalizerUnit
+
+    x = np.ones((4, 3), np.float32)
+    unit = MeanDispNormalizerUnit(name="mdn2")
+    unit.input = Array(x)
+    unit.mean.mem = np.zeros(3, np.float32)
+    unit.disp.mem = np.ones(3, np.float32)
+    unit.initialize(device=None)
+    unit.run()
+    np.testing.assert_allclose(np.array(unit.output.map_read()), x)
+    unit.mean.mem = np.ones(3, np.float32)     # refit
+    unit.run()
+    np.testing.assert_allclose(np.array(unit.output.map_read()),
+                               np.zeros_like(x))
+
+
+def test_resizable_reallocates_gd_velocities():
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.gd import GradientDescent
+    from znicz_tpu.resizable_all2all import ResizableAll2All
+
+    wf = Workflow(name="rszwf")
+    fwd = ResizableAll2All(wf, name="rszv", output_sample_shape=(4,))
+    fwd.input = Array(np.ones((2, 5), np.float32))
+    fwd.initialize(device=None)
+    gd = GradientDescent(wf, name="rszv_gd", forward=fwd,
+                         gradient_moment=0.9, need_err_input=False)
+    gd.err_output = Array(np.ones((2, 4), np.float32))
+    gd.initialize(device=None)
+    fwd.run(); gd.run()
+    fwd.resize(7)
+    assert gd._velocities["weights"].shape == (7, 5)
+    gd.err_output = Array(np.ones((2, 7), np.float32))
+    fwd.run(); gd.run()                        # no broadcast crash
+    assert np.array(fwd.weights.map_read()).shape == (7, 5)

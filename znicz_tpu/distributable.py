@@ -1,0 +1,71 @@
+"""Distributable protocol (rebuild of ``veles/distributable.py``).
+
+The reference's master/slave distribution required every unit to implement
+a 4-method data protocol:
+
+    generate_data_for_slave / apply_data_from_master   (master -> slave)
+    generate_data_for_master / apply_data_from_slave   (slave -> master)
+
+On TPU that transport no longer exists — gradient aggregation is a psum
+inside the fused jitted step (SURVEY.md §2.4) — but the PROTOCOL survives
+because it is also the unit-state serialization surface (snapshots, and any
+future DCN-side elastic mode).  ``Distributable`` gives every unit a
+default implementation over its param Arrays; ``GradientDescentBase`` and
+``ForwardBase`` get exactly the semantics the reference's NN units had
+(weights travel master->slave, gradients/updated-weights travel
+slave->master)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Distributable:
+    """Mixin; default: stateless unit (empty payloads)."""
+
+    negotiates_on_connect = False
+
+    def _param_arrays(self) -> Dict[str, "np.ndarray"]:
+        params = getattr(self, "params", None)
+        if callable(params):
+            return {k: np.array(a.map_read())
+                    for k, a in self.params().items()}
+        return {}
+
+    # -- master side ----------------------------------------------------------
+
+    def generate_data_for_slave(self) -> Optional[dict]:
+        """Master -> slave payload: current parameters."""
+        data = self._param_arrays()
+        return data or None
+
+    def apply_data_from_slave(self, data: Optional[dict]) -> None:
+        """Master absorbs a slave's update.  The reference's async
+        aggregation applied whole updated tensors; keep that semantic."""
+        if not data:
+            return
+        params = getattr(self, "params", None)
+        if callable(params):
+            for k, arr in self.params().items():
+                if k in data:
+                    arr.mem = np.asarray(data[k]).copy()
+
+    # -- slave side -----------------------------------------------------------
+
+    def apply_data_from_master(self, data: Optional[dict]) -> None:
+        if not data:
+            return
+        params = getattr(self, "params", None)
+        if callable(params):
+            for k, arr in self.params().items():
+                if k in data:
+                    arr.mem = np.asarray(data[k]).copy()
+
+    def generate_data_for_master(self) -> Optional[dict]:
+        """Slave -> master payload: updated parameters (the reference's GD
+        units shipped gradients or weights depending on mode; the rebuild
+        ships weights — the psum path never serializes at all)."""
+        data = self._param_arrays()
+        return data or None

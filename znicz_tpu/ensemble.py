@@ -1,0 +1,76 @@
+"""Ensemble training/evaluation (rebuild of ``veles/ensemble/``).
+
+The reference trained N instances of a workflow with different seeds and
+combined their predictions.  Rebuild:
+
+  - ``EnsembleTrainer(factory, n_models)`` — runs the factory N times with
+    distinct seeds, collecting each run's best metric and final params;
+  - ``EnsembleEvaluator`` — averages member softmax outputs (soft voting)
+    for a batch and reports combined n_err.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import numpy as np
+
+from znicz_tpu.core import prng
+
+
+class EnsembleTrainer:
+    """factory(seed) -> trained workflow with .decision and .forwards."""
+
+    def __init__(self, factory: Callable[[int], object], n_models: int = 3,
+                 base_seed: int = 1013):
+        self.factory = factory
+        self.n_models = int(n_models)
+        self.base_seed = int(base_seed)
+        self.members: List[object] = []
+        self.metrics: List[float] = []
+
+    def run(self):
+        for i in range(self.n_models):
+            seed = self.base_seed + 1000 * i
+            prng._streams.clear()
+            prng.seed_all(seed)
+            wf = self.factory(seed)
+            self.members.append(wf)
+            self.metrics.append(float(wf.decision.best_metric))
+        return self
+
+
+class EnsembleEvaluator:
+    """Soft-voting over member workflows' forward stacks.  Inference is a
+    PURE composition of each forward's ``apply`` (eval-mode branches for
+    dropout / stochastic pooling) — member workflows are never mutated."""
+
+    def __init__(self, members: List[object]):
+        self.members = list(members)
+
+    @staticmethod
+    def pure_forward(forwards, x):
+        import jax.numpy as jnp
+
+        from znicz_tpu.dropout import DropoutForward
+        from znicz_tpu.pooling import StochasticPoolingBase
+
+        h = jnp.asarray(x, jnp.float32)
+        for f in forwards:
+            if isinstance(f, DropoutForward):
+                continue                           # eval: identity
+            if isinstance(f, StochasticPoolingBase):
+                h, _ = f._select_expected(f.windows(h))
+                continue
+            params = {k: a.devmem for k, a in f.params().items()}
+            h = f.apply(params, h)
+        return h
+
+    def predict_proba(self, data: np.ndarray) -> np.ndarray:
+        probs = [np.array(self.pure_forward(wf.forwards, data))
+                 for wf in self.members]
+        return np.mean(probs, axis=0)
+
+    def n_err(self, data: np.ndarray, labels: np.ndarray) -> int:
+        pred = self.predict_proba(data).argmax(-1)
+        return int((pred != np.asarray(labels)).sum())

@@ -1,0 +1,86 @@
+"""Model forge (rebuild of ``veles/forge_client.py`` / ``veles/forge``).
+
+The reference's forge was a remote model-repository service (upload/download
+packaged workflows over HTTP).  This environment has no egress, so the
+rebuild implements the same operations against a LOCAL registry directory
+(the on-disk format is self-contained, so pointing ``registry`` at a shared
+mount gives the multi-user behavior):
+
+    forge = Forge()                      # root.common.dirs.forge
+    name = forge.upload(workflow, "mnist-mlp", metadata={...})
+    snap = forge.download("mnist-mlp")   # -> snapshot dict (restore() it)
+    forge.list()                         # -> [{"name", "time", ...}, ...]
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+from znicz_tpu.core.config import root
+
+root.common.dirs.defaults({"forge": "forge_registry"})
+
+
+class Forge:
+    def __init__(self, registry: Optional[str] = None):
+        self.registry = registry or root.common.dirs.get("forge",
+                                                         "forge_registry")
+        os.makedirs(self.registry, exist_ok=True)
+
+    def _pkg_dir(self, name: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+        if not safe.strip("_"):
+            raise ValueError(f"invalid package name {name!r}")
+        path = os.path.join(self.registry, safe)
+        # belt & braces: never resolve outside the registry
+        if not os.path.realpath(path).startswith(
+                os.path.realpath(self.registry) + os.sep):
+            raise ValueError(f"package name {name!r} escapes the registry")
+        return path
+
+    def upload(self, workflow, name: str,
+               metadata: Optional[Dict] = None) -> str:
+        from znicz_tpu import snapshotter
+
+        d = self._pkg_dir(name)
+        os.makedirs(d, exist_ok=True)
+        snap = snapshotter.collect(workflow)
+        snap["config"] = root.to_dict()
+        with gzip.open(os.path.join(d, "model.pickle.gz"), "wb") as f:
+            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        manifest = {"name": name, "workflow": workflow.name,
+                    "time": time.time(),
+                    "metadata": metadata or {}}
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        return name
+
+    def download(self, name: str) -> Dict:
+        d = self._pkg_dir(name)
+        with gzip.open(os.path.join(d, "model.pickle.gz"), "rb") as f:
+            return pickle.load(f)
+
+    def manifest(self, name: str) -> Dict:
+        with open(os.path.join(self._pkg_dir(name), "manifest.json")) as f:
+            return json.load(f)
+
+    def list(self) -> List[Dict]:
+        out = []
+        for entry in sorted(os.listdir(self.registry)):
+            path = os.path.join(self.registry, entry, "manifest.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    out.append(json.load(f))
+        return out
+
+    def delete(self, name: str) -> None:
+        import shutil
+
+        d = self._pkg_dir(name)
+        if os.path.isdir(d):
+            shutil.rmtree(d)

@@ -1,0 +1,33 @@
+"""Interactive shell unit (rebuild of ``veles/interaction.py``).
+
+The reference's ``Shell`` unit dropped into an IPython session inside the
+running workflow (gated, e.g., to epoch ends) with the workflow in scope.
+Same here; when IPython is unavailable (or ``interactive=False``) it falls
+back to ``code.interact`` / no-op so headless runs never block."""
+
+from __future__ import annotations
+
+from znicz_tpu.core.units import Unit
+
+
+class Shell(Unit):
+    def __init__(self, workflow=None, name=None, interactive=True, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.interactive = bool(interactive)
+        self.invocations = 0
+
+    def run(self):
+        self.invocations += 1
+        if not self.interactive:
+            return
+        ns = {"workflow": self.workflow, "unit": self}
+        banner = (f"znicz-tpu shell (workflow={self.workflow.name!r}); "
+                  "objects: workflow, unit; Ctrl-D to continue training")
+        try:
+            from IPython import embed
+
+            embed(banner1=banner, user_ns=ns, colors="neutral")
+        except ImportError:
+            import code
+
+            code.interact(banner=banner, local=ns)

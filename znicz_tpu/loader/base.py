@@ -35,6 +35,12 @@ class Loader(Unit):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.max_minibatch_size = int(kwargs.get("minibatch_size", 100))
         self.shuffle = kwargs.get("shuffle", True)
+        #: use the C++ xorshift128+ shuffler (native/znicz_native.cpp) —
+        #: the reference's RNG family; opt-in because it changes the
+        #: shuffle sequence vs the default numpy prng stream
+        self.native_shuffle = kwargs.get(
+            "native_shuffle", None)
+        self._native_rng = None
         self.class_lengths: List[int] = [0, 0, 0]
         self.minibatch_data = Array()
         self.minibatch_labels = Array()
@@ -103,11 +109,29 @@ class Loader(Unit):
             arr.initialize(device)
         self._shuffle_train()
 
+    def _use_native_shuffle(self) -> bool:
+        if self.native_shuffle is not None:
+            return bool(self.native_shuffle)
+        from znicz_tpu.core.config import root
+
+        return bool(root.common.engine.get("native_shuffle", False))
+
     def _shuffle_train(self) -> None:
         if not self.shuffle:
             return
         start = self.class_end_offsets[VALID]
         seg = self._shuffled_indices[start:]
+        if self._use_native_shuffle():
+            from znicz_tpu import native
+
+            if native.available():
+                if self._native_rng is None:
+                    self._native_rng = native.XorShift128P(
+                        prng.get("loader").seed)
+                seg = np.ascontiguousarray(seg)
+                self._native_rng.shuffle(seg)
+                self._shuffled_indices[start:] = seg
+                return
         perm = prng.get("loader").permutation(len(seg))
         self._shuffled_indices[start:] = seg[perm]
 

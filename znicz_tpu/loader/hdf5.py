@@ -1,0 +1,30 @@
+"""HDF5 loader (rebuild of ``veles/loader/hdf5.py``): serves an .h5/.hdf5
+file with datasets ``data`` and ``labels`` plus optional attrs/datasets
+``class_lengths`` ([test, valid, train]; default: all TRAIN)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+
+class HDF5Loader(FullBatchLoader):
+    def __init__(self, workflow=None, name=None, file_path=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.file_path = file_path
+
+    def load_data(self):
+        assert self.file_path, f"{self.name}: file_path required"
+        import h5py
+
+        with h5py.File(self.file_path, "r") as f:
+            self.original_data.mem = np.asarray(f["data"], np.float32)
+            if "labels" in f:
+                self.original_labels.mem = np.asarray(f["labels"], np.int32)
+            if "class_lengths" in f:
+                self.class_lengths = [int(x) for x in f["class_lengths"][:]]
+            elif "class_lengths" in f.attrs:
+                self.class_lengths = [int(x)
+                                      for x in f.attrs["class_lengths"]]
+        super().load_data()

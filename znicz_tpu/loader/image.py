@@ -1,0 +1,103 @@
+"""Image loaders (rebuild of ``veles/loader/image.py`` + ``file_image.py``).
+
+``FullBatchFileImageLoader`` walks class directories of image files, decodes
+with PIL, resizes/crops to a fixed ``target_shape``, converts u8 -> f32
+through the native C++ decode path (znicz_tpu.native) and serves them as a
+resident FullBatch dataset — the reference's directory-image pipeline with
+the scale/crop semantics preserved.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from znicz_tpu import native
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".pgm")
+
+
+def decode_image(path: str, target_shape: Tuple[int, int],
+                 grayscale: bool = False) -> np.ndarray:
+    """Decode + resize one image to (H, W[, 3]) float32 in [0, 1]."""
+    from PIL import Image
+
+    with Image.open(path) as img:
+        img = img.convert("L" if grayscale else "RGB")
+        img = img.resize((target_shape[1], target_shape[0]))
+        arr = np.asarray(img, np.uint8)
+    return native.u8_to_f32(arr)
+
+
+def scan_class_dirs(base: str,
+                    exts: Sequence[str] = IMAGE_EXTS
+                    ) -> Tuple[List[str], List[int], List[str]]:
+    """<base>/<class_name>/*.img -> (paths, labels, class_names)."""
+    class_names = sorted(
+        d for d in os.listdir(base)
+        if os.path.isdir(os.path.join(base, d)))
+    paths, labels = [], []
+    for ci, cname in enumerate(class_names):
+        cdir = os.path.join(base, cname)
+        for fname in sorted(os.listdir(cdir)):
+            if os.path.splitext(fname)[1].lower() in exts:
+                paths.append(os.path.join(cdir, fname))
+                labels.append(ci)
+    return paths, labels, class_names
+
+
+class FullBatchFileImageLoader(FullBatchLoader):
+    """kwargs: ``train_path`` (required), ``valid_path``, ``test_path`` —
+    each a directory of class subdirectories; ``target_shape=(H, W)``;
+    ``grayscale``."""
+
+    def __init__(self, workflow=None, name=None, train_path=None,
+                 valid_path=None, test_path=None, target_shape=(32, 32),
+                 grayscale=False, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.train_path = train_path
+        self.valid_path = valid_path
+        self.test_path = test_path
+        self.target_shape = tuple(target_shape)
+        self.grayscale = bool(grayscale)
+        self.class_names: Optional[List[str]] = None
+
+    def _load_split(self, base: Optional[str]):
+        """Class indices always come from the TRAIN directory's class_names
+        mapping (fixed before eval splits load); a split containing a class
+        absent from train is an error, not a silent relabel."""
+        if not base:
+            return np.zeros((0,) + self._sample_shape(), np.float32), \
+                np.zeros(0, np.int32)
+        paths, local_labels, names = scan_class_dirs(base)
+        index_of = {n: i for i, n in enumerate(self.class_names)}
+        unknown = [n for n in names if n not in index_of]
+        if unknown:
+            raise ValueError(
+                f"{self.name}: classes {unknown} in {base} are absent from "
+                f"train_path (classes: {self.class_names})")
+        labels = [index_of[names[l]] for l in local_labels]
+        data = np.stack([decode_image(p, self.target_shape, self.grayscale)
+                         for p in paths]) if paths else \
+            np.zeros((0,) + self._sample_shape(), np.float32)
+        return data.astype(np.float32), np.asarray(labels, np.int32)
+
+    def _sample_shape(self):
+        h, w = self.target_shape
+        return (h, w) if self.grayscale else (h, w, 3)
+
+    def load_data(self):
+        assert self.train_path, f"{self.name}: train_path required"
+        _, _, self.class_names = scan_class_dirs(self.train_path)
+        test_d, test_l = self._load_split(self.test_path)
+        valid_d, valid_l = self._load_split(self.valid_path)
+        train_d, train_l = self._load_split(self.train_path)
+        self.original_data.mem = np.concatenate(
+            [test_d, valid_d, train_d], axis=0)
+        self.original_labels.mem = np.concatenate(
+            [test_l, valid_l, train_l], axis=0)
+        self.class_lengths = [len(test_l), len(valid_l), len(train_l)]
+        super().load_data()

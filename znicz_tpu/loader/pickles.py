@@ -1,0 +1,57 @@
+"""Pickled-array loaders (rebuild of ``veles/loader/pickles.py``).
+
+``FullBatchPicklesLoader`` takes up to three pickle files (test/valid/train),
+each containing either a ``(data, labels)`` tuple or a dict with ``data`` /
+``labels`` arrays, and serves them as a resident dataset."""
+
+from __future__ import annotations
+
+import gzip
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+
+def load_pickle(path: str):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        obj = pickle.load(f)
+    if isinstance(obj, dict):
+        return (np.asarray(obj["data"], np.float32),
+                np.asarray(obj["labels"], np.int32))
+    data, labels = obj
+    return np.asarray(data, np.float32), np.asarray(labels, np.int32)
+
+
+class FullBatchPicklesLoader(FullBatchLoader):
+    def __init__(self, workflow=None, name=None, test_pickle=None,
+                 valid_pickle=None, train_pickle=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.test_pickle = test_pickle
+        self.valid_pickle = valid_pickle
+        self.train_pickle = train_pickle
+
+    def load_data(self):
+        assert self.train_pickle, f"{self.name}: train_pickle required"
+        splits = []
+        for path in (self.test_pickle, self.valid_pickle, self.train_pickle):
+            if path:
+                splits.append(load_pickle(path))
+            else:
+                splits.append((None, None))
+        sample_shape = splits[2][0].shape[1:]
+        datas, labels, lengths = [], [], []
+        for d, l in splits:
+            if d is None:
+                d = np.zeros((0,) + sample_shape, np.float32)
+                l = np.zeros(0, np.int32)
+            datas.append(d)
+            labels.append(l)
+            lengths.append(len(d))
+        self.original_data.mem = np.concatenate(datas, axis=0)
+        self.original_labels.mem = np.concatenate(labels, axis=0)
+        self.class_lengths = lengths
+        super().load_data()

@@ -1,0 +1,109 @@
+"""Minibatch stream capture/replay (rebuild of ``veles/loader/saver.py``).
+
+``MinibatchesSaver`` is a unit linked after any loader: it appends every
+served minibatch (data/labels/class/size) to a gzip pickle stream.
+``MinibatchesLoader`` replays such a file as a loader-compatible unit —
+the reference used this to freeze a preprocessing pipeline's output and
+retrain without the original dataset."""
+
+from __future__ import annotations
+
+import gzip
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Array
+
+
+class MinibatchesSaver(Unit):
+    def __init__(self, workflow=None, name=None, file_path="minibatches.pgz",
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.file_path = file_path
+        # linked from the loader:
+        self.minibatch_data: Optional[Array] = None
+        self.minibatch_labels: Optional[Array] = None
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0
+        self._file = None
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self._file = gzip.open(self.file_path, "wb")
+
+    def run(self):
+        rec = {
+            "data": np.array(self.minibatch_data.map_read()),
+            "labels": (np.array(self.minibatch_labels.map_read())
+                       if self.minibatch_labels else None),
+            "class": int(self.minibatch_class),
+            "size": int(self.minibatch_size),
+        }
+        pickle.dump(rec, self._file, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def stop(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class MinibatchesLoader(Unit):
+    """Replays a saved minibatch stream; exposes the Loader attribute
+    surface (minibatch_data/labels/class/size, last_minibatch,
+    epoch_number) so forwards/evaluators link against it unchanged."""
+
+    def __init__(self, workflow=None, name=None, file_path="minibatches.pgz",
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.file_path = file_path
+        self.records: List[dict] = []
+        self._pos = 0
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0
+        self.last_minibatch = False
+        self.class_ended = False
+        self.epoch_number = 0
+        self.epoch_ended = False
+        self.class_lengths = [0, 0, 0]
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.records = []
+        with gzip.open(self.file_path, "rb") as f:
+            while True:
+                try:
+                    self.records.append(pickle.load(f))
+                except EOFError:
+                    break
+        if not self.records:
+            raise ValueError(f"{self.name}: empty minibatch stream")
+        for rec in self.records:
+            self.class_lengths[rec["class"]] += rec["size"]
+        for arr in (self.minibatch_data, self.minibatch_labels):
+            arr.initialize(device)
+
+    def run(self):
+        if self.last_minibatch:
+            self._pos = 0
+            self.epoch_number += 1
+            self.last_minibatch = False
+        self.epoch_ended = False
+        rec = self.records[self._pos]
+        self.minibatch_data.mem = rec["data"]
+        if rec["labels"] is not None:
+            self.minibatch_labels.mem = rec["labels"]
+        self.minibatch_class = rec["class"]
+        self.minibatch_size = rec["size"]
+        self._pos += 1
+        self.last_minibatch = (self._pos == len(self.records))
+        self.epoch_ended = self.last_minibatch
+        nxt = self.records[self._pos] if self._pos < len(self.records) \
+            else None
+        self.class_ended = (nxt is None
+                            or nxt["class"] != self.minibatch_class)

@@ -1,0 +1,82 @@
+"""External-process feed (rebuild of ``veles/zmq_loader.py``): a loader-like
+unit that PULLs pickled minibatch dicts from a ZeroMQ socket, for pipelines
+where another process produces the data (the reference's streaming mode).
+
+Message format (pickled dict): ``{"data": ndarray, "labels": ndarray|None,
+"class": 0|1|2, "size": int, "last": bool}``.  A ``{"end": True}`` message
+marks end-of-stream (sets ``finished``)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Array
+
+
+class ZeroMQLoader(Unit):
+    def __init__(self, workflow=None, name=None,
+                 endpoint="tcp://127.0.0.1:5555", bind=True, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.endpoint = endpoint
+        self.bind = bool(bind)
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0
+        self.last_minibatch = False
+        # full Loader attribute surface so DecisionBase links work
+        # (class_lengths is unknown for a stream — senders may set it via
+        # the optional "class_lengths" field of any message)
+        self.class_ended = False
+        self.epoch_ended = False
+        self.epoch_number = 0
+        self.class_lengths = [0, 0, 0]
+        self.finished = False
+        self._socket = None
+        self._context = None
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        import zmq
+
+        self._context = zmq.Context.instance()
+        self._socket = self._context.socket(zmq.PULL)
+        if self.bind:
+            self._socket.bind(self.endpoint)
+        else:
+            self._socket.connect(self.endpoint)
+        for arr in (self.minibatch_data, self.minibatch_labels):
+            arr.initialize(device)
+
+    def run(self):
+        if self.last_minibatch:
+            self.epoch_number += 1
+            self.last_minibatch = False
+        self.epoch_ended = False
+        msg = self._socket.recv()
+        rec = pickle.loads(msg)
+        if rec.get("end"):
+            self.finished = True
+            self.last_minibatch = True
+            self.epoch_ended = True
+            self.class_ended = True
+            return
+        self.minibatch_data.mem = rec["data"]
+        if rec.get("labels") is not None:
+            self.minibatch_labels.mem = rec["labels"]
+        self.minibatch_class = int(rec.get("class", TRAIN))
+        self.minibatch_size = int(rec.get("size", len(rec["data"])))
+        self.last_minibatch = bool(rec.get("last", False))
+        self.class_ended = bool(rec.get("class_ended",
+                                        self.last_minibatch))
+        self.epoch_ended = self.last_minibatch
+        if rec.get("class_lengths") is not None:
+            self.class_lengths = [int(x) for x in rec["class_lengths"]]
+
+    def stop(self):
+        if self._socket is not None:
+            self._socket.close(0)
+            self._socket = None

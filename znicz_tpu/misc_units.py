@@ -1,0 +1,124 @@
+"""Misc NN units (rebuild of the reference's assorted ``znicz/*.py`` —
+SURVEY.md §2.2 "Misc units").
+
+  - ``MeanDispNormalizerUnit`` — in-graph input normalization: subtracts a
+    fitted mean and divides by dispersion on the fly (the reference's
+    ``MeanDispNormalizer`` unit form, distinct from the loader-side
+    normalizers in znicz_tpu/normalization.py);
+  - ``ZeroFiller`` — keeps a boolean mask of zeroed weight positions and
+    re-applies it after every update (the reference's sparsity mask);
+  - ``NNRollback`` — watches the loss and restores the last good parameter
+    snapshot when divergence is detected (loss > factor × best).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.memory import Array
+from znicz_tpu.nn_units import ForwardBase
+
+
+class MeanDispNormalizerUnit(ForwardBase):
+    """output = (input - mean) / disp, with mean/disp Arrays linked or set
+    (fit them with normalization.MeanDispNormalizer on the train split)."""
+
+    has_weights = False
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.mean = Array()
+        self.disp = Array()
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    @staticmethod
+    def _normalize(mean, disp, x):
+        flat = x.reshape(x.shape[0], -1)
+        return ((flat - mean) / disp).reshape(x.shape)
+
+    def apply(self, params, x):
+        # mean/disp are runtime state, not compile-time constants — the
+        # closure form would bake the first-seen values into the jit cache
+        raise NotImplementedError(
+            "stateful normalizer; use run() (mean/disp are traced args)")
+
+    def initialize(self, device=None, **kwargs):
+        assert self.mean.mem is not None and self.disp.mem is not None, \
+            f"{self.name}: set mean/disp before initialize"
+        self.create_output()
+        for arr in (self.mean, self.disp):
+            arr.initialize(device)
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+
+            self._compiled = jax.jit(self._normalize)
+        self.output.devmem = self._compiled(
+            self.mean.devmem, self.disp.devmem, self.input.devmem)
+
+
+class ZeroFiller(Unit):
+    """Re-zeroes masked weight positions after each update.  Bind forwards
+    with ``add_mask(forward_unit, mask)`` (mask: bool array, True = keep)."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self._masks = []                    # (forward, bool ndarray)
+
+    def add_mask(self, forward, mask: np.ndarray) -> None:
+        mask = np.asarray(mask, bool)
+        assert mask.shape == tuple(forward.weights.shape)
+        self._masks.append((forward, mask))
+
+    def run(self):
+        for fwd, mask in self._masks:
+            w = fwd.weights.map_write()
+            w[~mask] = 0.0
+
+
+class NNRollback(Unit):
+    """Divergence guard: keeps the best-loss parameter copy; when the
+    observed loss exceeds ``rollback_factor`` x best (or is non-finite),
+    restores it and reports via ``rollbacks``."""
+
+    def __init__(self, workflow=None, name=None, rollback_factor=4.0,
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.rollback_factor = float(rollback_factor)
+        self.loss = 0.0                      # link from evaluator/decision
+        self.best_loss = np.inf
+        self.rollbacks = 0
+        self._forwards = []
+        self._best: Optional[Dict] = None
+
+    def watch(self, *forwards) -> None:
+        self._forwards.extend(forwards)
+
+    def _snapshot(self) -> Dict:
+        return {f.name: {k: np.array(a.map_read())
+                         for k, a in f.params().items()}
+                for f in self._forwards}
+
+    def run(self):
+        loss = float(self.loss)
+        diverged = (not np.isfinite(loss)
+                    or (self._best is not None
+                        and loss > self.rollback_factor * self.best_loss))
+        if diverged:
+            for f in self._forwards:
+                for k, a in f.params().items():
+                    a.mem = self._best[f.name][k].copy()
+            self.rollbacks += 1
+            self.warning("loss %.4g diverged (best %.4g) -> rolled back",
+                         loss, self.best_loss)
+            return
+        if loss < self.best_loss:
+            self.best_loss = loss
+            self._best = self._snapshot()

@@ -30,10 +30,11 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.units import Unit
+from znicz_tpu.distributable import Distributable
 from znicz_tpu.memory import Array
 
 
-class ForwardBase(Unit):
+class ForwardBase(Unit, Distributable):
     """Base of every forward compute unit.
 
     Config kwargs (reference names):
@@ -156,7 +157,7 @@ def sgd_update(w, g, v, *, lr, weights_decay, l1_vs_l2, momentum, clip):
     return w + v_new, v_new
 
 
-class GradientDescentBase(Unit):
+class GradientDescentBase(Unit, Distributable):
     """Backward twin of a ``ForwardBase``: consumes ``err_output``, produces
     ``err_input`` and updates the forward's params in place (on device).
 
@@ -230,6 +231,21 @@ class GradientDescentBase(Unit):
             vel.initialize(device)
             self._velocities[k] = vel
         self.err_input.initialize(device)
+
+    # -- Distributable: a GD unit's serializable state is its optimizer
+    # -- accumulators (the forward owns the weights) --------------------------
+
+    def _param_arrays(self):
+        return {k: np.array(a.map_read())
+                for k, a in self._velocities.items()}
+
+    def apply_data_from_master(self, data):
+        if data:
+            for k, arr in self._velocities.items():
+                if k in data:
+                    arr.mem = np.asarray(data[k]).copy()
+
+    apply_data_from_slave = apply_data_from_master
 
     def _hypers(self):
         import numpy as np

@@ -66,10 +66,13 @@ class PoolingBase(ForwardBase):
         return (int(b), int(h), int(w), int(c), oh, ow, sy, sx, ph, pw)
 
     def windows(self, x):
-        """(B, OH, OW, C, ky*kx) view of all pooling windows."""
+        """(B, OH, OW, C, ky*kx) view of all pooling windows.  Spatial
+        geometry is the unit's static config; the batch dim follows ``x``
+        so eval-time batches of any size reuse the same unit."""
         import jax.numpy as jnp
 
-        b, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        _, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        b = x.shape[0]
         xp = jnp.pad(x, ((0, 0), (0, ph - h), (0, pw - w), (0, 0)),
                      constant_values=type(self).PAD_VALUE)
         ys = (np.arange(oh) * sy)[:, None] + np.arange(self.ky)[None, :]

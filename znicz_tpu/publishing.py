@@ -1,0 +1,96 @@
+"""Post-training report generation (rebuild of ``veles/publishing/``).
+
+The reference rendered run reports to HTML/PDF/Confluence backends.  The
+rebuild keeps a backend registry with Markdown and HTML backends that
+collect everything the reference's reports contained: workflow identity,
+config snapshot, per-class epoch metrics, best validation numbers, unit
+timing table, and any rendered plot PNGs."""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from znicz_tpu.core.config import root
+
+
+def gather_report(workflow) -> Dict:
+    from znicz_tpu.decision import CLASS_NAMES, DecisionBase
+
+    rep: Dict = {
+        "name": workflow.name,
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "config": root.to_dict(),
+        "units": [],
+        "metrics": {},
+    }
+    total = sum(u.run_time for u in workflow.units) or 1e-12
+    for u in sorted(workflow.units, key=lambda u: -u.run_time):
+        if u.run_count:
+            rep["units"].append({"name": u.name, "runs": u.run_count,
+                                 "time_s": round(u.run_time, 4),
+                                 "pct": round(100 * u.run_time / total, 1)})
+    for u in workflow.units:
+        if isinstance(u, DecisionBase):
+            rep["metrics"]["best_metric"] = float(u.best_metric)
+            rep["metrics"]["best_epoch"] = int(u.best_epoch)
+            rep["metrics"]["epochs"] = int(u.epoch_number) + 1
+            for k, m in enumerate(u.epoch_metrics):
+                if m is not None:
+                    rep["metrics"][CLASS_NAMES[k]] = {
+                        key: (float(v) if isinstance(v, (int, float))
+                              else None)
+                        for key, v in m.items() if key != "confusion"}
+    plots_dir = root.common.dirs.get("plots")
+    if plots_dir and os.path.isdir(plots_dir):
+        rep["plots"] = sorted(f for f in os.listdir(plots_dir)
+                              if f.endswith(".png"))
+    return rep
+
+
+class MarkdownBackend:
+    EXT = ".md"
+
+    def render(self, rep: Dict) -> str:
+        lines = [f"# Training report — {rep['name']}", "",
+                 f"Generated: {rep['time']}", "", "## Metrics", ""]
+        for key, val in rep["metrics"].items():
+            lines.append(f"- **{key}**: "
+                         f"{json.dumps(val) if isinstance(val, dict) else val}")
+        lines += ["", "## Unit timing", "",
+                  "| unit | runs | time (s) | % |", "|---|---|---|---|"]
+        for u in rep["units"]:
+            lines.append(f"| {u['name']} | {u['runs']} | {u['time_s']} "
+                         f"| {u['pct']} |")
+        for png in rep.get("plots", []):
+            lines.append(f"\n![{png}]({png})")
+        return "\n".join(lines) + "\n"
+
+
+class HTMLBackend:
+    EXT = ".html"
+
+    def render(self, rep: Dict) -> str:
+        md = MarkdownBackend().render(rep)
+        body = "".join(f"<p>{html.escape(line)}</p>\n"
+                       for line in md.splitlines() if line.strip())
+        return (f"<html><head><title>{html.escape(rep['name'])}</title>"
+                f"</head><body>{body}</body></html>\n")
+
+
+BACKENDS = {"markdown": MarkdownBackend, "html": HTMLBackend}
+
+
+def publish(workflow, backend: str = "markdown",
+            directory: Optional[str] = None) -> str:
+    rep = gather_report(workflow)
+    be = BACKENDS[backend]()
+    directory = directory or root.common.dirs.get("reports", "reports")
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{workflow.name}_report{be.EXT}")
+    with open(path, "w") as f:
+        f.write(be.render(rep))
+    return path

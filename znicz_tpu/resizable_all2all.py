@@ -1,0 +1,57 @@
+"""ResizableAll2All (rebuild of ``znicz/resizable_all2all.py``): a fully
+connected layer whose output width can grow (or shrink) mid-training —
+new rows are freshly initialized, surviving rows keep their trained values.
+The reference used this for progressively-widened nets."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.all2all import All2All
+from znicz_tpu.core import prng
+
+
+class ResizableAll2All(All2All):
+    def resize(self, new_width: int) -> None:
+        """Change output width in place; keeps trained rows, initializes new
+        ones from the unit's seeded stream.  Invalidates the jit cache (the
+        shapes changed) and the paired GD unit's velocity buffers."""
+        new_width = int(new_width)
+        old = self.weights.map_read()
+        out_old, in_size = old.shape if not self.weights_transposed \
+            else (old.shape[1], old.shape[0])
+        if new_width == out_old:
+            return
+        w = np.zeros((new_width, in_size), np.float32)
+        keep = min(out_old, new_width)
+        w[:keep] = old[:keep] if not self.weights_transposed \
+            else old[:, :keep].T
+        if new_width > out_old:
+            stddev = self.weights_stddev or 1.0 / np.sqrt(in_size)
+            grow = np.zeros((new_width - out_old, in_size), np.float32)
+            self._fill(grow, self.weights_filling, stddev)
+            w[out_old:] = grow
+        self.weights.mem = np.ascontiguousarray(
+            w.T) if self.weights_transposed else w
+        if self.include_bias:
+            b_old = self.bias.map_read()
+            b = np.zeros(new_width, np.float32)
+            b[:keep] = b_old[:keep]
+            self.bias.mem = b
+        self.output_sample_shape = (new_width,)
+        self.output_samples_number = new_width
+        if self.input is not None and self.input.mem is not None:
+            self.create_output()
+        self._compiled = None               # shapes changed -> recompile
+        # reallocate any paired GD unit's velocity buffers (momentum state
+        # for vanished/new rows is meaningless -> zeros) + its jit cache
+        if self.workflow is not None:
+            from znicz_tpu.nn_units import GradientDescentBase
+
+            for unit in self.workflow:
+                if (isinstance(unit, GradientDescentBase)
+                        and unit.forward is self and unit._velocities):
+                    for k, arr in self.params().items():
+                        unit._velocities[k].mem = np.zeros(
+                            arr.shape, np.float32)
+                    unit._compiled = None

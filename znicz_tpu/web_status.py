@@ -1,0 +1,115 @@
+"""Web status dashboard (rebuild of ``veles/web_status.py`` + ``veles/web``).
+
+The reference ran a tornado dashboard showing running workflows and the
+master/slave topology.  The rebuild serves the same information for the
+SPMD world — registered workflows' progress (epoch, metrics, unit timing)
+and the device mesh — over a tiny stdlib ThreadingHTTPServer:
+
+    status = WebStatus(port=8080).start()
+    status.register(workflow)
+    ... train ...
+    status.stop()
+
+Endpoints: ``/`` (HTML page, auto-refresh) and ``/status.json``.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+class WebStatus:
+    def __init__(self, port: int = 8080, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = int(port)
+        self.workflows: List[object] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, workflow) -> None:
+        if workflow not in self.workflows:
+            self.workflows.append(workflow)
+
+    # -- snapshotting the state (host side, lock-free reads) -------------------
+
+    def snapshot(self) -> dict:
+        from znicz_tpu.decision import DecisionBase
+
+        out = {"workflows": []}
+        try:
+            import jax
+
+            out["devices"] = [str(d) for d in jax.devices()]
+        except Exception:
+            out["devices"] = []
+        for wf in self.workflows:
+            info = {"name": wf.name, "stopped": bool(wf.stopped),
+                    "units": [{"name": u.name, "runs": u.run_count}
+                              for u in wf.units if u.run_count]}
+            for u in wf.units:
+                if isinstance(u, DecisionBase):
+                    info["epoch"] = int(u.epoch_number)
+                    info["best_metric"] = (None if u.best_metric != u.best_metric
+                                           or u.best_metric == float("inf")
+                                           else float(u.best_metric))
+                    info["complete"] = bool(u.complete)
+            out["workflows"].append(info)
+        return out
+
+    # -- server ----------------------------------------------------------------
+
+    def _make_handler(self):
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):       # silence request logging
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(status.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    snap = status.snapshot()
+                    rows = "".join(
+                        f"<tr><td>{html.escape(w['name'])}</td>"
+                        f"<td>{w.get('epoch', '-')}</td>"
+                        f"<td>{w.get('best_metric', '-')}</td>"
+                        f"<td>{'done' if w.get('complete') else 'running'}"
+                        f"</td></tr>"
+                        for w in snap["workflows"])
+                    body = (
+                        "<html><head><meta http-equiv='refresh' content='2'>"
+                        "<title>znicz-tpu status</title></head><body>"
+                        f"<h2>Devices</h2><p>{html.escape(', '.join(snap['devices']))}</p>"
+                        "<h2>Workflows</h2><table border=1>"
+                        "<tr><th>name</th><th>epoch</th><th>best</th>"
+                        f"<th>state</th></tr>{rows}</table>"
+                        "</body></html>").encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+    def start(self) -> "WebStatus":
+        self._server = ThreadingHTTPServer((self.host, self.port),
+                                           self._make_handler())
+        self.port = self._server.server_address[1]   # resolve port 0
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
