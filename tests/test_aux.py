@@ -134,3 +134,30 @@ def test_launcher_list():
     from znicz_tpu.launcher import main
 
     assert main(["--list"]) == 0
+
+def test_wine_sample(tmp_path):
+    root.common.dirs.snapshots = str(tmp_path)
+    root.wine.decision.max_epochs = 15
+    from znicz_tpu.samples import wine
+
+    wf = wine.run()
+    dec = wf.decision
+    assert bool(dec.complete)
+    valid = dec.epoch_metrics[1]
+    # 3 well-separated clusters after mean-disp normalization: near-perfect
+    assert valid["err_pct"] < 15.0, valid
+
+
+def test_device_benchmark_and_aliases():
+    from znicz_tpu.accelerated_units import (AcceleratedUnit,
+                                             AcceleratedWorkflow,
+                                             DeviceBenchmark)
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.nn_units import ForwardBase
+
+    assert AcceleratedUnit is ForwardBase
+    assert AcceleratedWorkflow is Workflow
+    bench = DeviceBenchmark(size=64, repeats=2)
+    results = bench.run()
+    assert "cpu" in results
+    assert bench.best() == "cpu"

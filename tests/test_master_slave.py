@@ -1,0 +1,69 @@
+"""Async ZeroMQ master/slave DP mode (reference parity: localhost
+master + slaves, SURVEY.md §4 'Distributed testing')."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+
+def _make_workflow(tmp_path):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng._streams.clear()
+    prng.seed_all(1013)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 3
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def test_master_slave_trains(tmp_path):
+    from znicz_tpu.client import Client
+    from znicz_tpu.server import Server
+
+    endpoint = "tcp://127.0.0.1:17570"
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, endpoint=endpoint, job_timeout=60.0)
+
+    # two slaves, each with its own replica (same seed -> same dataset)
+    slaves = [Client(_make_workflow(tmp_path / f"s{i}"), endpoint=endpoint,
+                     slave_id=f"slave{i}") for i in range(2)]
+
+    errors = []
+
+    def worker(s):
+        try:
+            s.run()
+        except BaseException as e:          # surface thread crashes
+            errors.append((s.slave_id, repr(e)))
+            raise
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in slaves]
+    for t in threads:
+        t.start()
+    server.serve()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+
+    dec = master_wf.decision
+    assert bool(dec.complete)
+    # async mode: updates arrive out of order, so epoch attribution is
+    # best-effort (reference semantics) — account by job counts instead
+    assert server.jobs_done >= 3 * 6 - len(slaves)   # 3 epochs x 6 batches
+    assert server.jobs_by_slave.get("slave0", 0) > 0
+    assert server.jobs_by_slave.get("slave1", 0) > 0
+    assert server.jobs_done == sum(server.jobs_by_slave.values())
+    # training actually converged on the master's aggregated params
+    valid = dec.epoch_metrics[1]
+    assert valid is not None and valid["err_pct"] < 70.0, valid

@@ -1,0 +1,71 @@
+"""Multi-head attention units (beyond-reference capability; see
+ops/attention.py for why).  Follows the framework's unit contract: pure
+``apply(params, x)``, a GD twin via vjp with the standard per-layer
+hyperparameters, registry type ``"attention"`` for StandardWorkflow.
+
+Input/output: (batch, seq, embed).  For sequence-parallel training, the
+fused path can swap the core for ``ops.attention.ring_attention`` inside a
+shard_map over the sequence axis (``sp_axis`` kwarg).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from znicz_tpu.memory import Array
+from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+from znicz_tpu.ops.attention import attention, ring_attention
+
+
+class MultiHeadAttention(ForwardBase):
+    def __init__(self, workflow=None, name=None, heads=4, head_dim=None,
+                 causal=False, sp_axis=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.heads = int(heads)
+        self.head_dim = head_dim           # default: embed // heads
+        self.causal = bool(causal)
+        self.sp_axis = sp_axis             # set inside shard_map for SP
+        self.proj = {k: Array() for k in ("wq", "wk", "wv", "wo")}
+
+    def params(self) -> Dict[str, Array]:
+        return dict(self.proj)
+
+    def output_shape_for(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, x):
+        b, t, e = x.shape
+        h, d = self.heads, self.head_dim
+        q = (x @ params["wq"]).reshape(b, t, h, d)
+        k = (x @ params["wk"]).reshape(b, t, h, d)
+        v = (x @ params["wv"]).reshape(b, t, h, d)
+        if self.sp_axis:
+            o = ring_attention(q, k, v, self.sp_axis, causal=self.causal)
+        else:
+            o = attention(q, k, v, causal=self.causal)
+        return o.reshape(b, t, h * d) @ params["wo"]
+
+    def initialize(self, device=None, **kwargs):
+        b, t, e = self.input.shape
+        if self.head_dim is None:
+            assert e % self.heads == 0, \
+                f"{self.name}: embed {e} not divisible by heads {self.heads}"
+            self.head_dim = int(e) // self.heads
+        hd = self.heads * self.head_dim
+        if self.proj["wq"].mem is None:
+            for key, shape in (("wq", (int(e), hd)), ("wk", (int(e), hd)),
+                               ("wv", (int(e), hd)), ("wo", (hd, int(e)))):
+                w = np.zeros(shape, np.float32)
+                self._fill(w, self.weights_filling,
+                           self.weights_stddev or 1.0 / np.sqrt(shape[0]))
+                self.proj[key].mem = w
+        self.create_output()
+        for arr in self.proj.values():
+            arr.initialize(device)
+        super().initialize(device=device, **kwargs)
+
+
+class GDMultiHeadAttention(GradientDescentBase):
+    """vjp of the attention forward; per-layer lr/momentum/decay as usual."""

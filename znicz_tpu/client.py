@@ -1,0 +1,115 @@
+"""Slave (rebuild of ``veles/client.py``): pulls jobs from the master,
+computes one minibatch on the LOCAL workflow replica (the slave owns its
+dataset copy like the reference's slaves did — the master only ships
+minibatch indices + params), and pushes back weight deltas + metrics.
+See server.py for the protocol; uses the Distributable payloads."""
+
+from __future__ import annotations
+
+import pickle
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from znicz_tpu.loader.base import TRAIN
+
+
+class Client:
+    def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
+                 slave_id: Optional[str] = None):
+        self.workflow = workflow
+        self.endpoint = endpoint
+        self.slave_id = slave_id or uuid.uuid4().hex[:8]
+        self.jobs_done = 0
+
+    def _rpc(self, sock, msg: dict) -> dict:
+        msg["id"] = self.slave_id
+        sock.send(pickle.dumps(msg))
+        return pickle.loads(sock.recv())
+
+    def _apply_params(self, params: Dict) -> None:
+        for f in self.workflow.forwards:
+            if f.has_weights and f.name in params:
+                f.apply_data_from_master(params[f.name])
+
+    def _deltas_since(self, before: Dict) -> Dict:
+        out = {}
+        for f in self.workflow.forwards:
+            if not f.has_weights:
+                continue
+            layer = {}
+            for k, arr in f.params().items():
+                layer[k] = np.array(arr.map_read()) - before[f.name][k]
+            out[f.name] = layer
+        return out
+
+    def _run_minibatch(self, job: dict, train: bool) -> Dict:
+        wf = self.workflow
+        loader = wf.loader
+        # inject the master's assignment into the local loader buffers
+        idx = loader.minibatch_indices.map_invalidate()
+        idx[...] = np.asarray(job["indices"], idx.dtype)
+        loader.minibatch_size = job["size"]
+        loader.minibatch_class = job["class"]
+        loader.fill_minibatch()
+        for f in wf.forwards:
+            f.run()
+        wf.evaluator.run()
+        metrics = {"loss": float(wf.evaluator.loss)}
+        if hasattr(wf.evaluator, "n_err"):
+            metrics["n_err"] = int(wf.evaluator.n_err)
+            metrics["confusion"] = np.array(
+                wf.evaluator.confusion_matrix.map_read())
+        if train:
+            wf.decision.gd_skip.set(False)
+            for gd in wf.gds:
+                gd.run()
+        return metrics
+
+    def _connect(self, ctx, timeout_ms: int):
+        import zmq
+
+        sock = ctx.socket(zmq.REQ)
+        sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(self.endpoint)
+        return sock
+
+    def run(self, poll_sleep: float = 0.05,
+            recv_timeout: float = 15.0) -> int:
+        """Work until the master reports done (or goes silent past
+        ``recv_timeout`` — master-death tolerance); returns jobs done."""
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = self._connect(ctx, int(recv_timeout * 1000))
+        try:
+            self._rpc(sock, {"cmd": "register"})
+            while True:
+                try:
+                    rep = self._rpc(sock, {"cmd": "job"})
+                except zmq.Again:
+                    return self.jobs_done       # master gone -> stop clean
+                if rep.get("done"):
+                    return self.jobs_done
+                if "job" not in rep:
+                    time.sleep(poll_sleep)
+                    continue
+                job, params = rep["job"], rep["params"]
+                self._apply_params(params)
+                before = {name: {k: np.asarray(v) for k, v in layer.items()}
+                          for name, layer in params.items()}
+                train = bool(rep.get("train"))
+                metrics = self._run_minibatch(job, train)
+                deltas = self._deltas_since(before) if train else None
+                try:
+                    self._rpc(sock, {"cmd": "update",
+                                     "job_id": rep["job_id"],
+                                     "deltas": deltas, "metrics": metrics})
+                except zmq.Again:
+                    return self.jobs_done       # master gone mid-update
+                self.jobs_done += 1
+        finally:
+            sock.close(0)

@@ -53,6 +53,7 @@ class EnsembleEvaluator:
         import jax.numpy as jnp
 
         from znicz_tpu.dropout import DropoutForward
+        from znicz_tpu.misc_units import MeanDispNormalizerUnit
         from znicz_tpu.pooling import StochasticPoolingBase
 
         h = jnp.asarray(x, jnp.float32)
@@ -61,6 +62,9 @@ class EnsembleEvaluator:
                 continue                           # eval: identity
             if isinstance(f, StochasticPoolingBase):
                 h, _ = f._select_expected(f.windows(h))
+                continue
+            if isinstance(f, MeanDispNormalizerUnit):
+                h = f._normalize(f.mean.devmem, f.disp.devmem, h)
                 continue
             params = {k: a.devmem for k, a in f.params().items()}
             h = f.apply(params, h)

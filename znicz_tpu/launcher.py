@@ -27,7 +27,7 @@ from typing import List, Optional
 from znicz_tpu.core.config import apply_overrides, root
 from znicz_tpu.core.logger import setup_logging
 
-SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet")
+SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet", "wine")
 
 
 def _load_module(spec: str, tag: str):

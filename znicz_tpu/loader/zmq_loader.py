@@ -18,10 +18,12 @@ from znicz_tpu.memory import Array
 
 class ZeroMQLoader(Unit):
     def __init__(self, workflow=None, name=None,
-                 endpoint="tcp://127.0.0.1:5555", bind=True, **kwargs):
+                 endpoint="tcp://127.0.0.1:5555", bind=True,
+                 recv_timeout=30.0, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.endpoint = endpoint
         self.bind = bool(bind)
+        self.recv_timeout = float(recv_timeout)   # seconds; feeder-death guard
         self.minibatch_data = Array()
         self.minibatch_labels = Array()
         self.minibatch_class = TRAIN
@@ -44,6 +46,9 @@ class ZeroMQLoader(Unit):
 
         self._context = zmq.Context.instance()
         self._socket = self._context.socket(zmq.PULL)
+        self._socket.setsockopt(zmq.RCVTIMEO,
+                                int(self.recv_timeout * 1000))
+        self._socket.setsockopt(zmq.LINGER, 0)
         if self.bind:
             self._socket.bind(self.endpoint)
         else:
@@ -56,7 +61,14 @@ class ZeroMQLoader(Unit):
             self.epoch_number += 1
             self.last_minibatch = False
         self.epoch_ended = False
-        msg = self._socket.recv()
+        import zmq
+
+        try:
+            msg = self._socket.recv()
+        except zmq.Again:
+            raise RuntimeError(
+                f"{self.name}: no minibatch from {self.endpoint} within "
+                f"{self.recv_timeout}s — feeder process dead or absent")
         rec = pickle.loads(msg)
         if rec.get("end"):
             self.finished = True

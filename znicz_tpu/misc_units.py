@@ -112,6 +112,12 @@ class NNRollback(Unit):
                     or (self._best is not None
                         and loss > self.rollback_factor * self.best_loss))
         if diverged:
+            if self._best is None:
+                # diverged before any good state existed — nothing to
+                # restore; report loudly and let the caller decide
+                self.warning("loss %.4g diverged with no good snapshot yet "
+                             "(nothing to roll back to)", loss)
+                return
             for f in self._forwards:
                 for k, a in f.params().items():
                     a.mem = self._best[f.name][k].copy()

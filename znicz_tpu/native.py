@@ -155,6 +155,9 @@ def gather_f32(src: np.ndarray, idx: np.ndarray,
     out_shape = (len(idx),) + src.shape[1:]
     if dst is None:
         dst = np.empty(out_shape, np.float32)
+    elif not (dst.flags.c_contiguous and dst.dtype == np.float32):
+        raise ValueError("dst must be a C-contiguous float32 buffer "
+                         "(reshape of a strided view would write a copy)")
     if available():
         flat = dst.reshape(len(idx), -1)
         _lib.znicz_gather_f32(

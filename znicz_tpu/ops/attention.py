@@ -1,0 +1,101 @@
+"""Attention ops, single-device and sequence-parallel (ring attention).
+
+The reference has no attention anywhere (SURVEY.md §5 "long-context:
+absent") — this is a beyond-reference, TPU-first capability so the
+framework handles long sequences at the scale the task demands:
+
+  - ``attention(q, k, v, causal)`` — standard scaled-dot-product MHA core,
+    one fused jit (XLA flash-fuses the softmax chain on TPU);
+  - ``ring_attention(q, k, v, axis_name, causal)`` — blockwise attention
+    for SEQUENCE-PARALLEL inputs: every device of the mesh axis holds a
+    sequence shard of q/k/v; k/v blocks rotate around the ring via
+    ``lax.ppermute`` (ICI neighbor hops, bandwidth-optimal) while a running
+    flash-style online softmax (max/denominator carried per query) keeps
+    memory at one block — exact attention over sequences n_devices x
+    longer than a chip could hold.  Call inside ``shard_map`` over the
+    sequence axis.
+
+Shapes: (batch, seq, heads, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0):
+    """Exact attention; offsets give global positions for causal masking of
+    sharded blocks."""
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
+                      -jnp.inf, s)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Each step attends the local q block to the current k/v block, folds the
+    result into flash-style accumulators, then passes the k/v block to the
+    next device on the ring.  After n steps every q saw every k/v.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    neg = jnp.finfo(jnp.float32.dtype).min
+
+    qpos = my * t + jnp.arange(t)                      # global q positions
+
+    def step(i, carry):
+        m, l, acc, k_blk, v_blk = carry
+        src = (my - i) % n                             # who produced k_blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        kmask = None
+        if causal:
+            kpos = src * t + jnp.arange(t)
+            kmask = (kpos[None, None, None, :]
+                     > qpos[None, None, :, None])
+            s = jnp.where(kmask, neg, s)
+        blk_max = jnp.max(s, axis=-1)                  # (b, h, q)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - m_new[..., None])
+        if kmask is not None:
+            # fully-masked blocks leave m_new at neg; exp(neg-neg)=1 would
+            # leak mass — zero masked entries explicitly
+            p = jnp.where(kmask, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = (acc * corr[..., None]
+                   + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk))
+        perm = [(j, (j + 1) % n) for j in range(n)]    # ring hop
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    def vary(x):
+        """Mark a fresh array as varying over the mesh axis (newer jax
+        shard_map tracks varying-axis types; loop carries must match)."""
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+
+    m0 = vary(jnp.full((b, h, t), neg, jnp.float32))
+    l0 = vary(jnp.zeros((b, h, t), jnp.float32))
+    acc0 = vary(jnp.zeros((b, h, t, d), jnp.float32))
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]       # (b, h, q, d)
+    return out.transpose(0, 2, 1, 3)                   # (b, q, h, d)

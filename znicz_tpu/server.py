@@ -1,0 +1,183 @@
+"""Master (rebuild of ``veles/server.py``).
+
+The TPU rebuild's PRIMARY distribution is SPMD psum inside the fused step
+(znicz_tpu/parallel) — zero-copy, synchronous, ICI-speed.  This module
+preserves the reference's OTHER mode for capability parity: an
+**asynchronous master/slave parameter server over ZeroMQ** (veles' only
+strategy, SURVEY.md §2.4) for heterogeneous/elastic fleets that cannot join
+a mesh:
+
+  - slaves REQ jobs; the master REPs minibatch index assignments plus
+    current params (``generate_data_for_slave`` on each trainable unit);
+  - slaves push back weight DELTAS + evaluator metrics; the master applies
+    them as they arrive — no barrier (the reference's async semantics);
+  - slave join/leave is inherently elastic: a lost job is re-queued after
+    ``job_timeout``.
+
+Transport is pyzmq REP with pickle payloads, mirroring the reference's
+pickle-over-ZMQ (trusted-cluster assumption documented there too).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Dict, List, Optional
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TRAIN
+
+
+class Server:
+    """Drive with ``serve()`` (blocks until the decision completes).
+
+    workflow requirements: ``loader``, ``forwards``, ``decision`` — the
+    graph built by StandardWorkflow or the samples.
+    """
+
+    def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
+                 job_timeout: float = 30.0):
+        self.workflow = workflow
+        self.endpoint = endpoint
+        self.job_timeout = float(job_timeout)
+        self.loader = workflow.loader
+        self.decision = workflow.decision
+        self.slaves: Dict[str, float] = {}          # id -> last seen
+        self.jobs_done = 0
+        self.jobs_by_slave: Dict[str, int] = {}
+        self._pending: List[dict] = []              # re-queued lost jobs
+        self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
+        self._job_seq = 0
+        self._socket = None
+
+    # -- params <-> payloads ---------------------------------------------------
+
+    def _trainables(self):
+        return [f for f in self.workflow.forwards if f.has_weights]
+
+    def snapshot_params(self) -> Dict:
+        return {f.name: f.generate_data_for_slave()
+                for f in self._trainables()}
+
+    def apply_deltas(self, deltas: Dict) -> None:
+        for f in self._trainables():
+            d = deltas.get(f.name)
+            if not d:
+                continue
+            for k, arr in f.params().items():
+                if k in d:
+                    mem = arr.map_write()
+                    mem += d[k]
+
+    # -- job management --------------------------------------------------------
+
+    def _reap_lost_jobs(self) -> None:
+        now = time.time()
+        lost = [jid for jid, (_, t, _) in self._inflight.items()
+                if now - t > self.job_timeout]
+        for jid in lost:
+            job, _, sid = self._inflight.pop(jid)
+            self._pending.append(job)
+
+    def _next_job(self) -> Optional[dict]:
+        self._reap_lost_jobs()
+        if self._pending:
+            return self._pending.pop(0)
+        if bool(self.decision.complete):
+            return None
+        self.loader.run()
+        import numpy as np
+
+        return {
+            "indices": np.array(self.loader.minibatch_indices.mem).copy(),
+            "class": int(self.loader.minibatch_class),
+            "size": int(self.loader.minibatch_size),
+            "last_minibatch": bool(self.loader.last_minibatch),
+            "class_ended": bool(self.loader.class_ended),
+            "epoch_number": int(self.loader.epoch_number),
+        }
+
+    def _feed_decision(self, job: dict, metrics: dict) -> None:
+        d = self.decision
+        d.minibatch_class = job["class"]
+        d.last_minibatch = job["last_minibatch"]
+        d.class_ended = job["class_ended"]
+        d.epoch_number = job["epoch_number"]
+        d.class_lengths = self.loader.class_lengths
+        d.minibatch_size = job["size"]
+        d.minibatch_loss = float(metrics.get("loss", 0.0))
+        if hasattr(d, "minibatch_n_err"):
+            d.minibatch_n_err = int(metrics.get("n_err", 0))
+            d.confusion_matrix = metrics.get("confusion")
+        d.run()
+
+    # -- the REP loop ----------------------------------------------------------
+
+    def serve(self, linger: float = 3.0) -> None:
+        """Blocks until the decision completes, then keeps draining for
+        ``linger`` seconds so every slave's outstanding request gets a
+        ``done`` reply (a request sent the instant training finished must
+        not be orphaned — the slave would block in recv forever)."""
+        import zmq
+
+        ctx = zmq.Context.instance()
+        self._socket = ctx.socket(zmq.REP)
+        self._socket.bind(self.endpoint)
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+        deadline = None
+        try:
+            while True:
+                if bool(self.decision.complete):
+                    # jobs still out with crashed slaves will never be
+                    # re-served — reap on timeout and drop, else serve()
+                    # would poll forever waiting on a dead peer
+                    self._reap_lost_jobs()
+                    self._pending.clear()
+                finished = (bool(self.decision.complete)
+                            and not self._inflight and not self._pending)
+                if finished and deadline is None:
+                    deadline = time.time() + linger
+                if deadline is not None and time.time() > deadline:
+                    break
+                if poller.poll(100):
+                    req = pickle.loads(self._socket.recv())
+                    self._socket.send(pickle.dumps(self._handle(req)))
+        finally:
+            self._socket.close(0)
+            self._socket = None
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        sid = req.get("id", "?")
+        self.slaves[sid] = time.time()
+        if cmd == "register":
+            return {"ok": True,
+                    "class_lengths": list(self.loader.class_lengths)}
+        if cmd == "job":
+            if bool(self.decision.complete):
+                return {"done": True}
+            job = self._next_job()
+            if job is None:
+                return {"done": True}
+            self._job_seq += 1
+            jid = self._job_seq
+            self._inflight[jid] = (job, time.time(), sid)
+            return {"job_id": jid, "job": job,
+                    "params": self.snapshot_params(),
+                    "train": job["class"] == TRAIN}
+        if cmd == "update":
+            jid = req.get("job_id")
+            entry = self._inflight.pop(jid, None)
+            if entry is None:
+                return {"ok": False, "stale": True}
+            job, _, _ = entry
+            if req.get("deltas"):
+                self.apply_deltas(req["deltas"])
+            # async arrivals after completion must not rewind decision state
+            if not bool(self.decision.complete):
+                self._feed_decision(job, req.get("metrics", {}))
+            self.jobs_done += 1
+            self.jobs_by_slave[sid] = self.jobs_by_slave.get(sid, 0) + 1
+            return {"ok": True, "complete": bool(self.decision.complete)}
+        return {"error": f"unknown cmd {cmd!r}"}

@@ -70,12 +70,14 @@ def _registry() -> Dict[str, Tuple[Type, Optional[Type]]]:
         "activation_sincos": (act.ForwardSinCos, act.BackwardSinCos),
         "activation_tanhlog": (act.ForwardTanhLog, act.BackwardTanhLog),
     }
-    from znicz_tpu import deconv, depooling, gd_deconv
+    from znicz_tpu import attention, deconv, depooling, gd_deconv
 
     reg["deconv"] = (deconv.Deconv, gd_deconv.GDDeconv)
     reg["deconv_tanh"] = (deconv.DeconvTanh, gd_deconv.GDDeconvTanh)
     reg["deconv_sigmoid"] = (deconv.DeconvSigmoid, gd_deconv.GDDeconvSigmoid)
     reg["depooling"] = (depooling.Depooling, depooling.GDDepooling)
+    reg["attention"] = (attention.MultiHeadAttention,
+                        attention.GDMultiHeadAttention)
     try:
         from znicz_tpu import resizable_all2all
 
