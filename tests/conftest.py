@@ -1,32 +1,12 @@
-"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
-import so sharding/collective tests run anywhere (SURVEY.md §4)."""
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE any jax
+backend initialization so sharding/collective tests run anywhere
+(SURVEY.md §4).  The fragile recipe (env forcing, axon-plugin deregistration,
+jax.config re-pin) lives in znicz_tpu/virtdev.py, shared with
+__graft_entry__.dryrun_multichip."""
 
-import os
+from znicz_tpu.virtdev import provision_cpu_devices
 
-# Force (not setdefault): this machine pre-exports JAX_PLATFORMS=axon (remote
-# TPU), under which the suite would compile remotely and hang; and
-# --xla_force_host_platform_device_count only applies to the cpu platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The axon (remote-TPU) PJRT plugin registers itself from sitecustomize.py
-# BEFORE this file runs.  Even under JAX_PLATFORMS=cpu, jax initializes every
-# *registered* plugin, and the axon tunnel is single-claim: a second process
-# blocks forever in make_c_api_client.  Deregister the factory so tests are
-# pure-CPU and can run concurrently with TPU work.
-try:
-    import jax
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-    # register() may have already pinned jax_platforms=axon via jax.config
-    # (which overrides the env var) — pin it back.
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+provision_cpu_devices(8)
 
 import pytest  # noqa: E402
 

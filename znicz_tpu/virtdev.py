@@ -1,0 +1,45 @@
+"""Virtual-device provisioning — the ONE home of the axon-plugin gotchas.
+
+Forces an n-virtual-device CPU platform so sharding/collective code runs on
+hosts without n real chips (SURVEY.md §4 "multi-device tests on CPU via
+XLA_FLAGS=--xla_force_host_platform_device_count").  Shared by
+``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip`` so the fragile
+recipe (env forcing, axon deregistration, jax.config re-pin) is maintained in
+exactly one place.
+
+Must be called BEFORE the first jax *backend initialization*; calling it
+after ``import jax`` is fine (XLA parses the flags at first client creation,
+verified empirically on this stack).
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def provision_cpu_devices(n: int) -> None:
+    """Pin this process to a CPU platform exposing >= n virtual devices.
+
+    Safe to call repeatedly; an existing forced count is only ever raised,
+    never lowered.  The axon (remote-TPU) PJRT plugin registers itself from
+    sitecustomize at interpreter start and pins jax_platforms=axon via
+    jax.config (which overrides the env var); its tunnel is single-claim, so
+    we deregister the factory before jax can claim it for a CPU-only run.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = re.sub(_COUNT_FLAG + r"=\d+", f"{_COUNT_FLAG}={n}", flags)
+    os.environ["XLA_FLAGS"] = flags
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
