@@ -32,13 +32,16 @@ def run_unit(wf):
                     for f in wf.forwards}
 
 
-def run_fused(wf, mesh=None):
+def run_fused(wf, mesh=None, tp_threshold=None):
     from znicz_tpu.parallel.fused import FusedTrainer
 
     losses = []
     wf.decision.on_epoch_end.append(
         lambda d: losses.append(d.epoch_metrics[2]["loss"]))
-    FusedTrainer(wf, mesh=mesh).run()
+    trainer = FusedTrainer(wf, mesh=mesh)
+    if tp_threshold is not None:
+        trainer.tp_threshold = tp_threshold
+    trainer.run()
     return losses, {f.name: np.array(f.weights.map_read())
                     for f in wf.forwards}
 
@@ -66,6 +69,98 @@ def test_fused_data_parallel_8dev_matches_single(tmp_path):
     np.testing.assert_allclose(l1, l8, rtol=1e-4)
     for name in w1:
         np.testing.assert_allclose(w1[name], w8[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def hybrid_mesh():
+    """A (data=4, model=2) mesh: batch sharded over ``data``, the 100-wide
+    hidden FC row-sharded over ``model`` (tp_threshold lowered to 64)."""
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    return make_mesh((4, 2), ("data", "model"))
+
+
+def test_fused_tp_hybrid_mesh_matches_single(tmp_path):
+    """Tensor parallelism correctness: a hybrid data x model mesh must
+    reproduce the single-device losses AND weights (GSPMD inserts the
+    collectives; the math may not change)."""
+    root.common.dirs.snapshots = str(tmp_path)
+    l1, w1 = run_fused(fresh_mnist())
+    lt, wt = run_fused(fresh_mnist(), mesh=hybrid_mesh(), tp_threshold=64)
+    np.testing.assert_allclose(l1, lt, rtol=1e-4)
+    for name in w1:
+        np.testing.assert_allclose(w1[name], wt[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def test_fused_tp_hybrid_mesh_matches_single_bf16(tmp_path):
+    """Same TP-parity property under mixed precision: bf16 on the hybrid
+    mesh vs bf16 single-device (looser tolerances — bf16 collective
+    reduction order differs)."""
+    root.common.dirs.snapshots = str(tmp_path)
+    root.common.engine.precision = "bfloat16"
+    try:
+        l1, w1 = run_fused(fresh_mnist())
+        lt, wt = run_fused(fresh_mnist(), mesh=hybrid_mesh(),
+                           tp_threshold=64)
+    finally:
+        root.common.engine.precision = "float32"
+    np.testing.assert_allclose(l1, lt, rtol=5e-2)
+    assert lt[-1] < lt[0] * 0.9, lt             # and it actually trains
+    for name in w1:
+        np.testing.assert_allclose(w1[name], wt[name], rtol=5e-2,
+                                   atol=5e-3, err_msg=name)
+
+
+def test_fused_snapshot_restore_continue(tmp_path):
+    """Restore-then-continue UNDER FusedTrainer: velocities + prng streams
+    must round-trip, and the continued trajectory must match the unit
+    engine continuing from the very same snapshot."""
+    from znicz_tpu import snapshotter as snap_mod
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+    from znicz_tpu.snapshotter import Snapshotter
+
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = fresh_mnist(max_epochs=2)
+    FusedTrainer(wf).run()
+    path = wf.snapshotter.destination
+    assert path is not None
+    snap = Snapshotter.load(path)
+
+    def resume(engine):
+        prng._streams.clear()
+        prng.seed_all(1013)
+        root.mnist.decision.max_epochs = 4           # 2 more epochs
+        losses = []
+        wf2 = mnist.MnistWorkflow()
+        wf2.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        wf2.initialize(device=None)
+        snap_mod.restore(wf2, snap)
+        if engine == "fused":
+            trainer = FusedTrainer(wf2)
+            # restored velocities must be what the trainer picks up
+            for name, layer in trainer.extract_velocities().items():
+                gd_name = trainer.gd_of[name].name
+                for k, v in layer.items():
+                    np.testing.assert_allclose(
+                        np.asarray(v), snap["velocities"][gd_name][k],
+                        err_msg=f"{gd_name}.{k}")
+            trainer.run()
+        else:
+            wf2.run()
+        assert bool(wf2.decision.complete)
+        return losses, {f.name: np.array(f.weights.map_read())
+                        for f in wf2.forwards}
+
+    lf, wf_f = resume("fused")
+    lu, wf_u = resume("unit")
+    assert len(lf) >= 2 and len(lf) == len(lu)       # continuation ran
+    np.testing.assert_allclose(lf, lu, rtol=1e-4)
+    for name in wf_u:
+        np.testing.assert_allclose(wf_u[name], wf_f[name], rtol=2e-3,
                                    atol=2e-5, err_msg=name)
 
 

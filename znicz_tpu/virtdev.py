@@ -43,3 +43,15 @@ def provision_cpu_devices(n: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    # XLA parses the flags at FIRST client creation only: if backends were
+    # already initialized with fewer devices, the env rewrite above silently
+    # did nothing — fail here with the real cause instead of a confusing
+    # device-count error far downstream.
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"provision_cpu_devices({n}): jax already initialized with "
+            f"{have} device(s); virtual CPU devices must be provisioned "
+            "before the first backend creation (re-exec in a fresh process)")
