@@ -4,15 +4,30 @@ chip (BASELINE.md north-star metric).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 
+Protocol (unsoftened AlexNet — VERDICT r1 item 3):
+  - full 1000-class fc8 (the real AlexNet head);
+  - 1024 resident training images (227x227x3) + 128 validation;
+  - FRESH minibatch indices every step, drawn by driving the Loader state
+    machine exactly like ``FusedTrainer.run`` does — the gather/input path
+    varies per step and per epoch (reshuffle), nothing is cached;
+  - a jax.profiler trace of 3 post-timing steps lands in ``bench_profile/``
+    (best-effort: some remote platforms cannot trace).
+
 ``vs_baseline`` divides by 500 img/s — the widely published cuDNN-Caffe
 AlexNet training throughput on a K40, standing in for the reference's own
 number, which is unobtainable here (BASELINE.md: reference mount empty, no
 network).  Update BASELINE.json.published when a real number lands.
+
+``python bench.py --samples`` instead measures the BASELINE configs 0-3
+finals (MNIST / CIFAR / MnistAE / Kohonen at their default sample configs)
+and prints one JSON line per config — the numbers recorded in BASELINE.md's
+"Measured" column.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -22,6 +37,10 @@ K40_ALEXNET_IMG_S = 500.0   # documented stand-in (see module docstring)
 BATCH = 128
 WARMUP = 3
 STEPS = 20
+N_TRAIN = 1024
+N_VALID = 128
+N_CLASSES = 1000
+PROFILE_DIR = "bench_profile"
 
 
 def main() -> None:
@@ -31,13 +50,14 @@ def main() -> None:
     prng.seed_all(1013)
     root.common.engine.precision = "bfloat16"   # params fp32, MXU bf16
     root.alexnet.loader.minibatch_size = BATCH
-    root.alexnet.loader.n_train = BATCH * 2
-    root.alexnet.loader.n_valid = BATCH
-    root.alexnet.loader.n_classes = 100
-    root.alexnet.decision.max_epochs = 1
+    root.alexnet.loader.n_train = N_TRAIN
+    root.alexnet.loader.n_valid = N_VALID
+    root.alexnet.loader.n_classes = N_CLASSES
+    root.alexnet.decision.max_epochs = 10_000   # bench drives steps itself
 
     import jax
 
+    from znicz_tpu.loader.base import TRAIN
     from znicz_tpu.parallel.fused import FusedTrainer
     from znicz_tpu.samples.alexnet import AlexNetWorkflow
 
@@ -49,26 +69,42 @@ def main() -> None:
     vels = trainer.extract_velocities()
     dataset = wf.loader.original_data.devmem
     targets = wf.loader.original_labels.devmem
-    wf.loader.run()
-    while wf.loader.minibatch_class != 2:       # reach a TRAIN minibatch
-        wf.loader.run()
-    idx = wf.loader.minibatch_indices.devmem
-    bs = np.int32(wf.loader.minibatch_size)
-
     hypers = trainer.hypers()
+
+    def next_train_minibatch():
+        """Advance the loader to its next TRAIN minibatch (fresh indices;
+        epoch boundaries reshuffle, exactly as in training)."""
+        while True:
+            wf.loader.run()
+            if wf.loader.minibatch_class == TRAIN:
+                return (wf.loader.minibatch_indices.devmem,
+                        np.int32(wf.loader.minibatch_size))
+
+    def one_step(p, v, i):
+        idx, bs = next_train_minibatch()
+        return step(p, v, hypers, dataset, targets, idx, bs,
+                    prng.get("bench").jax_key(i))
+
     for i in range(WARMUP):
-        params, vels, metrics = step(params, vels, hypers, dataset, targets,
-                                     idx, bs, prng.get("bench").jax_key(i))
+        params, vels, metrics = one_step(params, vels, i)
     jax.block_until_ready(metrics)
 
     t0 = time.perf_counter()
     for i in range(STEPS):
-        params, vels, metrics = step(params, vels, hypers, dataset, targets,
-                                     idx, bs,
-                                     prng.get("bench").jax_key(100 + i))
+        params, vels, metrics = one_step(params, vels, 100 + i)
     jax.block_until_ready(metrics)
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
     elapsed = time.perf_counter() - t0
+
+    # post-timing profiler trace (never perturbs the measurement above)
+    try:
+        with jax.profiler.trace(PROFILE_DIR):
+            for i in range(3):
+                params, vels, metrics = one_step(params, vels, 1000 + i)
+            jax.block_until_ready(metrics)
+        print(f"profiler trace -> {PROFILE_DIR}/", file=sys.stderr)
+    except Exception as exc:                      # platform can't trace
+        print(f"profiler trace unavailable: {exc!r}", file=sys.stderr)
 
     img_s = BATCH * STEPS / elapsed
     print(json.dumps({
@@ -79,5 +115,54 @@ def main() -> None:
     }))
 
 
+def _gd_finals(decision) -> dict:
+    from znicz_tpu.loader.base import TRAIN, VALID
+
+    return {"final_train_loss": round(decision.epoch_metrics[TRAIN]["loss"], 6),
+            "valid_err_pct": round(decision.epoch_metrics[VALID]["err_pct"], 3),
+            "epochs": int(decision.epoch_number) + 1}
+
+
+def _mse_finals(decision) -> dict:
+    from znicz_tpu.loader.base import TRAIN, VALID
+
+    return {"final_train_mse": round(decision.epoch_metrics[TRAIN]["loss"], 6),
+            "valid_mse": round(decision.epoch_metrics[VALID]["loss"], 6),
+            "epochs": int(decision.epoch_number) + 1}
+
+
+def _som_finals(decision) -> dict:
+    return {"final_qerror": round(decision.epoch_qerror[-1], 6),
+            "first_qerror": round(decision.epoch_qerror[0], 6),
+            "epochs": len(decision.epoch_qerror)}
+
+
+#: BASELINE config index -> (sample module name, finals extractor)
+SAMPLE_CONFIGS = [
+    (0, "mnist", _gd_finals),
+    (1, "cifar", _gd_finals),
+    (2, "mnist_ae", _mse_finals),
+    (3, "kohonen", _som_finals),
+]
+
+
+def measure_samples() -> None:
+    """BASELINE configs 0-3 at their default sample configs; one JSON line
+    each (the BASELINE.md "Measured" column)."""
+    import importlib
+
+    from znicz_tpu.core import prng
+
+    for config, name, finals in SAMPLE_CONFIGS:
+        prng.reset(1013)
+        module = importlib.import_module(f"znicz_tpu.samples.{name}")
+        wf = module.run()
+        print(json.dumps({"config": config, "sample": name,
+                          **finals(wf.decision)}))
+
+
 if __name__ == "__main__":
-    main()
+    if "--samples" in sys.argv[1:]:
+        measure_samples()
+    else:
+        main()

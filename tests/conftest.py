@@ -16,6 +16,5 @@ def _fixed_seed():
     """Every test starts from the same global seed and a clean stream table."""
     from znicz_tpu.core import prng
 
-    prng._streams.clear()
-    prng.seed_all(1013)
+    prng.reset(1013)
     yield

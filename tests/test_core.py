@@ -254,8 +254,7 @@ class TestPrng:
         from znicz_tpu.core import prng
 
         a1 = prng.get("w1").normal(1.0, (4,))
-        prng._streams.clear()
-        prng.seed_all(1013)
+        prng.reset(1013)
         a2 = prng.get("w1").normal(1.0, (4,))
         np.testing.assert_array_equal(a1, a2)
 
@@ -263,8 +262,7 @@ class TestPrng:
         from znicz_tpu.core import prng
 
         a = prng.get("alpha").normal(1.0, (3,))
-        prng._streams.clear()
-        prng.seed_all(1013)
+        prng.reset(1013)
         _ = prng.get("beta").normal(1.0, (3,))
         a2 = prng.get("alpha").normal(1.0, (3,))
         np.testing.assert_array_equal(a, a2)

@@ -11,8 +11,7 @@ def fresh_mnist(max_epochs=2):
     from znicz_tpu.core import prng
     from znicz_tpu.samples import mnist
 
-    prng._streams.clear()
-    prng.seed_all(1013)
+    prng.reset(1013)
     root.mnist.loader.n_train = 300
     root.mnist.loader.n_valid = 60
     root.mnist.loader.n_test = 0
@@ -130,8 +129,7 @@ def test_fused_snapshot_restore_continue(tmp_path):
     snap = Snapshotter.load(path)
 
     def resume(engine):
-        prng._streams.clear()
-        prng.seed_all(1013)
+        prng.reset(1013)
         root.mnist.decision.max_epochs = 4           # 2 more epochs
         losses = []
         wf2 = mnist.MnistWorkflow()

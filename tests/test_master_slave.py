@@ -13,8 +13,7 @@ def _make_workflow(tmp_path):
     from znicz_tpu.core import prng
     from znicz_tpu.samples import mnist
 
-    prng._streams.clear()
-    prng.seed_all(1013)
+    prng.reset(1013)
     root.mnist.loader.n_train = 300
     root.mnist.loader.n_valid = 60
     root.mnist.loader.minibatch_size = 60

@@ -56,8 +56,7 @@ def test_mnist_deterministic(small_mnist):
     from znicz_tpu.samples import mnist
 
     def one_run():
-        prng._streams.clear()
-        prng.seed_all(1013)
+        prng.reset(1013)
         losses = []
         wf = mnist.MnistWorkflow()
         wf.decision.on_epoch_end.append(
@@ -81,8 +80,7 @@ def test_mnist_snapshot_resume(small_mnist, tmp_path):
     assert path is not None
 
     # resume into a fresh workflow; weights must match the snapshot
-    prng._streams.clear()
-    prng.seed_all(1013)
+    prng.reset(1013)
     root.mnist.decision.max_epochs = 5               # train 2 more epochs
     wf2 = mnist.MnistWorkflow()
     wf2.initialize(device=None)

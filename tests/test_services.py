@@ -15,8 +15,7 @@ def _tiny_trained_mnist(tmp_path, epochs=1):
     from znicz_tpu.core import prng
     from znicz_tpu.samples import mnist
 
-    prng._streams.clear()
-    prng.seed_all(1013)
+    prng.reset(1013)
     root.mnist.loader.n_train = 120
     root.mnist.loader.n_valid = 60
     root.mnist.loader.minibatch_size = 60

@@ -107,3 +107,11 @@ def seed_all(seed: int) -> None:
     root.common.engine.seed = int(seed)
     for stream in _streams.values():
         stream.reseed(_global_seed)
+
+
+def reset(seed: int) -> None:
+    """Drop every named stream and reseed: the state is indistinguishable
+    from a fresh process started with this global seed.  The public home of
+    the ``_streams.clear(); seed_all(seed)`` idiom."""
+    _streams.clear()
+    seed_all(seed)

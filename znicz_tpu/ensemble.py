@@ -32,8 +32,7 @@ class EnsembleTrainer:
     def run(self):
         for i in range(self.n_models):
             seed = self.base_seed + 1000 * i
-            prng._streams.clear()
-            prng.seed_all(seed)
+            prng.reset(seed)
             wf = self.factory(seed)
             self.members.append(wf)
             self.metrics.append(float(wf.decision.best_metric))
