@@ -114,6 +114,68 @@ def test_minibatch_saver_and_replay(tmp_path):
                                served[0])
 
 
+def test_lmdb_codec_roundtrip_and_overflow(tmp_path):
+    """MDBWriter -> MDBReader round-trip: key ordering, get(), multi-page
+    trees, and values large enough to spill to overflow pages."""
+    from znicz_tpu.loader.lmdb import MDBReader, MDBWriter
+
+    rng = np.random.default_rng(7)
+    items = {b"%08d" % i: rng.bytes(int(n))
+             for i, n in enumerate(rng.integers(1, 9000, size=300))}
+    items[b"zz-last"] = b"x" * 20000          # multi-page overflow chain
+    path = str(tmp_path / "data.mdb")
+    MDBWriter().write(path, items)
+    with MDBReader(path) as r:
+        assert r.entries == len(items)
+        assert r.depth >= 2                    # 300 records span pages
+        got = dict(r.items())
+        assert got == items
+        assert list(got) == sorted(items)      # cursor walks in key order
+        for key in (b"%08d" % 0, b"%08d" % 299, b"zz-last"):
+            assert r.get(key) == items[key]
+        assert r.get(b"absent") is None
+
+
+def test_lmdb_codec_empty_and_single(tmp_path):
+    from znicz_tpu.loader.lmdb import MDBReader, MDBWriter
+
+    path = str(tmp_path / "empty.mdb")
+    MDBWriter().write(path, {})
+    with MDBReader(path) as r:
+        assert r.entries == 0
+        assert list(r.items()) == []
+        assert r.get(b"a") is None
+
+    path = str(tmp_path / "one.mdb")
+    MDBWriter().write(path, {b"k": b"v"})
+    with MDBReader(path) as r:
+        assert r.entries == 1 and r.depth == 1
+        assert r.get(b"k") == b"v"
+
+
+def test_lmdb_loader(tmp_path):
+    """The SURVEY §2.1 loader-family test pattern: write a tiny LMDB
+    in-test, load it, assert the class walk + data round-trip."""
+    from znicz_tpu.loader.lmdb import LMDBLoader, write_dataset
+
+    rng = np.random.default_rng(11)
+    data = rng.normal(size=(12, 6)).astype(np.float32)
+    labels = rng.integers(0, 3, size=12).astype(np.int32)
+    path = str(tmp_path / "ds.mdb")
+    write_dataset(path, data, labels, class_lengths=[0, 4, 8])
+
+    ld = LMDBLoader(name="lmdbld", file_path=path, minibatch_size=4)
+    ld.initialize(device=None)
+    assert ld.class_lengths == [0, 4, 8]
+    np.testing.assert_allclose(ld.original_data.mem, data)
+    np.testing.assert_array_equal(ld.original_labels.mem, labels)
+    ld.run()
+    assert ld.minibatch_class == VALID         # VALID walks before TRAIN
+    assert ld.minibatch_size == 4
+    ld.run()
+    assert ld.minibatch_class == TRAIN
+
+
 def test_zmq_loader():
     import zmq
 

@@ -1,0 +1,386 @@
+"""LMDB loader (rebuild of the reference's LMDB dataset support, SURVEY.md
+§2.1 "Other loaders").
+
+The environment has no ``lmdb`` binding and no network, so this module
+implements the LMDB **on-disk format itself** (the format of
+``liblmdb``'s ``data.mdb``):
+
+  - ``MDBReader``: zero-copy mmap reader — meta-page election by txnid,
+    B+tree descent over branch/leaf pages, overflow-page values.  Reads
+    databases produced by real liblmdb (single unnamed main DB, default
+    flags) as well as by ``MDBWriter``.
+  - ``MDBWriter``: bulk writer producing a spec-conformant file: meta pages
+    0/1 (page size recorded in FREE-db md_pad, as liblmdb does), sorted
+    leaf pages, branch levels up to a single root, ``F_BIGDATA`` overflow
+    chains for large values.
+
+When the real ``lmdb`` package IS importable it is preferred for reading
+(gated at call time), keeping this pure-Python path as the fallback.
+
+Dataset convention (documented, ours): keys ``b"%08d" % i`` with pickled
+``(sample_ndarray, label)`` values, plus a ``b"__meta__"`` record holding
+``{"class_lengths": [n_test, n_valid, n_train]}``.  ``write_dataset`` /
+``LMDBLoader`` round-trip it.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+MDB_MAGIC = 0xBEEFC0DE
+MDB_VERSION = 1
+PAGESIZE = 4096
+PAGEHDRSZ = 16
+NODESZ = 8                       # MDB_node header
+P_BRANCH, P_LEAF, P_OVERFLOW, P_META = 0x01, 0x02, 0x04, 0x08
+F_BIGDATA = 0x01
+P_INVALID = 0xFFFFFFFFFFFFFFFF
+MAXKEYSIZE = 511
+
+# MDB_db: md_pad u32, md_flags u16, md_depth u16, branch/leaf/overflow
+# pages u64 x3, entries u64, root u64  (48 bytes)
+_DB = struct.Struct("<IHHQQQQQ")
+# page header: pgno u64, pad u16, flags u16, lower u16, upper u16
+_PGHDR = struct.Struct("<QHHHH")
+# meta tail after the two MDB_db slots: last_pg u64, txnid u64
+_NODEHDR = struct.Struct("<HHHH")
+
+
+def _even(n: int) -> int:
+    return (n + 1) & ~1
+
+
+class MDBWriter:
+    """Bulk-build a single-DB LMDB file from (key, value) pairs."""
+
+    def __init__(self, pagesize: int = PAGESIZE):
+        self.psize = pagesize
+        # liblmdb: me_nodemax = (((psize - PAGEHDRSZ) / MDB_MINKEYS) & -2)
+        #          - sizeof(indx_t); larger leaf nodes spill to overflow
+        self.nodemax = (((pagesize - PAGEHDRSZ) // 2) & ~1) - 2
+        self.pages: List[bytes] = []              # data pages; pgno = i + 2
+
+    def _overflow(self, value: bytes) -> Tuple[int, int]:
+        """Store value in an overflow chain; returns (first pgno, n_pages)."""
+        n = (PAGEHDRSZ + len(value) + self.psize - 1) // self.psize
+        first = len(self.pages) + 2
+        hdr = _PGHDR.pack(first, 0, P_OVERFLOW, 0, 0)
+        # pb_pages overlays lower/upper as a u32 at offset 12
+        hdr = hdr[:12] + struct.pack("<I", n)
+        blob = hdr + value
+        blob += b"\x00" * (n * self.psize - len(blob))
+        for i in range(n):
+            self.pages.append(blob[i * self.psize:(i + 1) * self.psize])
+        return first, n
+
+    def _pack_page(self, pgno: int, flags: int,
+                   nodes: List[bytes]) -> bytes:
+        """Assemble ptrs (ascending key order) + nodes (packed from the page
+        end downward, as liblmdb does)."""
+        page = bytearray(self.psize)
+        offsets, upper = [], self.psize
+        for node in reversed(nodes):
+            upper -= _even(len(node))
+            page[upper:upper + len(node)] = node
+            offsets.append(upper)
+        offsets.reverse()
+        lower = PAGEHDRSZ + 2 * len(nodes)
+        assert lower <= upper, "page overflow (writer packing bug)"
+        page[:PAGEHDRSZ] = _PGHDR.pack(pgno, 0, flags, lower, upper)
+        page[PAGEHDRSZ:lower] = struct.pack(f"<{len(nodes)}H", *offsets)
+        return bytes(page)
+
+    def _leaf_node(self, key: bytes, value: bytes) -> bytes:
+        if NODESZ + len(key) + len(value) > self.nodemax:
+            pgno, _ = self._overflow(value)
+            return _NODEHDR.pack(len(value) & 0xFFFF, len(value) >> 16,
+                                 F_BIGDATA, len(key)) + key + \
+                struct.pack("<Q", pgno)
+        return _NODEHDR.pack(len(value) & 0xFFFF, len(value) >> 16,
+                             0, len(key)) + key + value
+
+    def _branch_node(self, key: bytes, child: int) -> bytes:
+        # child pgno packed into lo | hi<<16 | flags<<32 (48-bit pgno)
+        return _NODEHDR.pack(child & 0xFFFF, (child >> 16) & 0xFFFF,
+                             (child >> 32) & 0xFFFF, len(key)) + key
+
+    def _fill_level(self, make_node, items) -> List[Tuple[bytes, List]]:
+        """Greedy page fill: [(first_key, [node, ...]), ...]."""
+        groups, cur, used = [], [], 0
+        for key, payload in items:
+            node = make_node(key, payload)
+            cost = 2 + _even(len(node))
+            if cur and PAGEHDRSZ + used + cost > self.psize:
+                groups.append((cur[0][0], [n for _, n in cur]))
+                cur, used = [], 0
+            cur.append((key, node))
+            used += cost
+        if cur:
+            groups.append((cur[0][0], [n for _, n in cur]))
+        return groups
+
+    def write(self, path: str, items: Dict[bytes, bytes],
+              mapsize: Optional[int] = None) -> None:
+        for k in items:
+            if not 0 < len(k) <= MAXKEYSIZE:
+                raise ValueError(f"bad key length {len(k)}")
+        self.pages = []                     # a writer instance is reusable
+        ordered = sorted(items.items())
+        n_branch = n_leaf = 0
+
+        def emit(flags: int, nodes: List[bytes]) -> int:
+            """Pack a tree page with its final pgno (overflow pages were
+            already appended by _leaf_node, so pgnos never need fixing)."""
+            pgno = len(self.pages) + 2          # pages 0/1 are the metas
+            self.pages.append(self._pack_page(pgno, flags, nodes))
+            return pgno
+
+        if not ordered:
+            root, depth = P_INVALID, 0
+        else:
+            level = []                      # [(first_key, pgno)]
+            for first, nodes in self._fill_level(self._leaf_node, ordered):
+                level.append((first, emit(P_LEAF, nodes)))
+                n_leaf += 1
+            depth = 1
+            while len(level) > 1:
+                # the level's leftmost separator key is empty (liblmdb
+                # ignores node0 keys during descent; ours is shortest-valid)
+                branch_items = [(b"" if i == 0 else k, c)
+                                for i, (k, c) in enumerate(level)]
+                nxt = []
+                for first, nodes in self._fill_level(self._branch_node,
+                                                     branch_items):
+                    nxt.append((first, emit(P_BRANCH, nodes)))
+                    n_branch += 1
+                level = nxt
+                depth += 1
+            root = level[0][1]
+
+        n_over = len(self.pages) - n_leaf - n_branch
+        last_pg = len(self.pages) + 1
+        size = (last_pg + 1) * self.psize
+        if mapsize is None:
+            mapsize = max(size, 1 << 20)
+        free_db = _DB.pack(self.psize, 0, 0, 0, 0, 0, 0, P_INVALID)
+        main_db = _DB.pack(0, 0, depth, n_branch, n_leaf, n_over,
+                           len(ordered), root)
+
+        def meta(txnid: int, pgno: int) -> bytes:
+            body = struct.pack("<IIQQ", MDB_MAGIC, MDB_VERSION, 0, mapsize)
+            body += free_db + main_db
+            body += struct.pack("<QQ", last_pg, txnid)
+            page = _PGHDR.pack(pgno, 0, P_META, 0, 0) + body
+            return page + b"\x00" * (self.psize - len(page))
+
+        if os.path.isdir(path):
+            path = os.path.join(path, "data.mdb")
+        with open(path, "wb") as f:
+            f.write(meta(1, 0))             # live meta (higher txnid)
+            f.write(meta(0, 1))
+            for pg in self.pages:
+                f.write(pg)
+
+
+class MDBReader:
+    """mmap reader for a single-DB LMDB file (default flags)."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            path = os.path.join(path, "data.mdb")
+        self._f = open(path, "rb")
+        try:
+            self._m = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        except Exception:
+            self._f.close()
+            raise
+
+        def parse_meta(byte_off: int):
+            off = byte_off + PAGEHDRSZ
+            magic, version = struct.unpack_from("<II", self._m, off)
+            if magic != MDB_MAGIC:
+                return None
+            if version != MDB_VERSION:
+                raise ValueError(f"unsupported LMDB version {version}")
+            free_db = _DB.unpack_from(self._m, off + 24)
+            main_db = _DB.unpack_from(self._m, off + 24 + _DB.size)
+            _, txnid = struct.unpack_from(
+                "<QQ", self._m, off + 24 + 2 * _DB.size)
+            return txnid, free_db[0] or PAGESIZE, main_db
+
+        try:
+            meta0 = parse_meta(0)
+            if meta0 is None:
+                raise ValueError(f"{path}: not an LMDB data file (bad magic)")
+            # meta page 1 lives at psize (recorded in FREE-db md_pad, which
+            # may differ from 4096 — e.g. 16K-page hosts)
+            meta1 = parse_meta(meta0[1])
+        except Exception:
+            self.close()
+            raise
+        txnid, self.psize, main = max(m for m in (meta0, meta1) if m)
+        (_, _, self.depth, _, _, _, self.entries, self.root) = main
+
+    def close(self) -> None:
+        self._m.close()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- page access ---------------------------------------------------------
+
+    def _page(self, pgno: int) -> Tuple[int, int, int, int]:
+        off = pgno * self.psize
+        _, _, flags, lower, upper = _PGHDR.unpack_from(self._m, off)
+        return off, flags, lower, upper
+
+    def _node(self, page_off: int, ptr_i: int):
+        ptr = struct.unpack_from(
+            "<H", self._m, page_off + PAGEHDRSZ + 2 * ptr_i)[0]
+        lo, hi, flags, ksize = _NODEHDR.unpack_from(self._m,
+                                                    page_off + ptr)
+        key_off = page_off + ptr + NODESZ
+        key = bytes(self._m[key_off:key_off + ksize])
+        return lo, hi, flags, key, key_off + ksize
+
+    def _nkeys(self, lower: int) -> int:
+        return (lower - PAGEHDRSZ) // 2
+
+    def _leaf_value(self, lo, hi, nflags, data_off) -> bytes:
+        size = lo | (hi << 16)
+        if nflags & F_BIGDATA:
+            ovpg = struct.unpack_from("<Q", self._m, data_off)[0]
+            start = ovpg * self.psize + PAGEHDRSZ
+            return bytes(self._m[start:start + size])
+        return bytes(self._m[data_off:data_off + size])
+
+    # -- cursor / lookup -----------------------------------------------------
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        """All (key, value) pairs in key order."""
+        if self.root == P_INVALID:
+            return
+        stack = [(self.root, 0)]
+        while stack:
+            pgno, i = stack.pop()
+            off, flags, lower, _ = self._page(pgno)
+            n = self._nkeys(lower)
+            if i >= n:
+                continue
+            if flags & P_LEAF:
+                for j in range(i, n):
+                    lo, hi, nf, key, data_off = self._node(off, j)
+                    yield key, self._leaf_value(lo, hi, nf, data_off)
+            else:
+                stack.append((pgno, i + 1))
+                lo, hi, nf, _, _ = self._node(off, i)
+                child = lo | (hi << 16) | (nf << 32)
+                stack.append((child, 0))
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if self.root == P_INVALID:
+            return None
+        pgno = self.root
+        while True:
+            off, flags, lower, _ = self._page(pgno)
+            n = self._nkeys(lower)
+            if flags & P_LEAF:
+                for j in range(n):          # binary search not worth it here
+                    lo, hi, nf, k, data_off = self._node(off, j)
+                    if k == key:
+                        return self._leaf_value(lo, hi, nf, data_off)
+                return None
+            child = None
+            for j in range(n):
+                lo, hi, nf, k, _ = self._node(off, j)
+                if j > 0 and k > key:
+                    break
+                child = lo | (hi << 16) | (nf << 32)
+            pgno = child
+
+
+# -- dataset convention -------------------------------------------------------
+
+META_KEY = b"__meta__"
+
+
+def write_dataset(path: str, data: np.ndarray, labels: np.ndarray,
+                  class_lengths: Optional[List[int]] = None) -> None:
+    """Write (data[i], labels[i]) records + the __meta__ record."""
+    items = {b"%08d" % i: pickle.dumps(
+        (np.asarray(data[i]), int(labels[i])),
+        protocol=pickle.HIGHEST_PROTOCOL) for i in range(len(data))}
+    meta = {"class_lengths": ([0, 0, len(data)] if class_lengths is None
+                              else [int(x) for x in class_lengths])}
+    items[META_KEY] = pickle.dumps(meta)
+    MDBWriter().write(path, items)
+
+
+def _read_pairs_real_lmdb(path: str):
+    import lmdb as _lmdb                                  # gated preference
+
+    env = _lmdb.open(path, subdir=os.path.isdir(path), readonly=True,
+                     lock=False)
+    try:
+        with env.begin() as txn:
+            return [(bytes(k), bytes(v)) for k, v in txn.cursor()]
+    finally:
+        env.close()
+
+
+def read_dataset(path: str):
+    """(data, labels, class_lengths) via real lmdb when importable, falling
+    back to the pure-Python reader on ANY binding failure (not just a
+    missing package — e.g. a liblmdb/file disagreement)."""
+    try:
+        pairs = _read_pairs_real_lmdb(path)
+    except Exception:
+        with MDBReader(path) as reader:
+            pairs = list(reader.items())
+    data, labels, meta = [], [], None
+    for key, value in pairs:
+        if key == META_KEY:
+            meta = pickle.loads(value)
+        else:
+            sample, label = pickle.loads(value)
+            data.append(sample)
+            labels.append(label)
+    if data:
+        data = np.stack(data).astype(np.float32)
+    else:
+        data = np.zeros((0,), np.float32)
+    labels = np.asarray(labels, np.int32)
+    lengths = (meta or {}).get("class_lengths", [0, 0, len(data)])
+    return data, labels, lengths
+
+
+class LMDBLoader(FullBatchLoader):
+    """Serves an LMDB dataset (keys %08d, pickled (sample, label) values)
+    as a resident FullBatch dataset.  ``class_lengths`` kwarg overrides the
+    stored __meta__ split."""
+
+    def __init__(self, workflow=None, name=None, file_path=None,
+                 class_lengths=None, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.file_path = file_path
+        self._class_lengths_override = class_lengths
+
+    def load_data(self):
+        assert self.file_path, f"{self.name}: file_path required"
+        data, labels, lengths = read_dataset(self.file_path)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = list(self._class_lengths_override or lengths)
+        super().load_data()
