@@ -1,0 +1,116 @@
+"""Live plot streaming: GraphicsServer (XPUB) -> separate GraphicsClient
+process rendering the same figures the offline path produces (SURVEY.md L9
+"Graphics")."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+
+
+def test_live_streaming_to_client_process(tmp_path):
+    """Spawn the real client process, stream two epochs of error curves
+    plus a weights tile through a training-shaped plotter set, assert the
+    client rendered every figure."""
+    from znicz_tpu.graphics import GraphicsServer
+    from znicz_tpu.memory import Array
+    from znicz_tpu.plotting_units import AccumulatingPlotter, Weights2D
+
+    out = tmp_path / "live"
+    server = GraphicsServer.start("tcp://127.0.0.1:*")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu.graphics", server.endpoint,
+             str(out), "--max-figures", "3", "--timeout", "60"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, text=True)
+        assert server.wait_for_subscribers(1, timeout=30)
+
+        losses = iter([2.0, 1.0])
+        acc = AccumulatingPlotter(name="live_loss",
+                                  fetch=lambda: next(losses))
+        weights = Weights2D(
+            name="live_w",
+            source=Array(np.random.default_rng(0).normal(
+                size=(4, 16)).astype(np.float32)),
+            sample_shape=(4, 4))
+        acc.run()          # epoch 0
+        acc.run()          # epoch 1
+        weights.run()
+        stdout, _ = proc.communicate(timeout=60)
+    finally:
+        GraphicsServer.stop()
+    assert proc.returncode == 0
+    assert "rendered 3 figures" in stdout
+    assert (out / "live_loss.png").exists()
+    assert (out / "live_w.png").exists()
+    # while a server is active, units stream INSTEAD of rendering offline
+    assert not os.path.exists(os.path.join(
+        root.common.dirs.get("plots", "plots"), "live_loss.png"))
+
+
+def test_graceful_offline_degradation(tmp_path):
+    """No server active -> plotters render offline PNGs exactly as before."""
+    from znicz_tpu.graphics import GraphicsServer
+    from znicz_tpu.plotting_units import AccumulatingPlotter
+
+    assert GraphicsServer.active() is None
+    root.common.dirs.plots = str(tmp_path)
+    vals = iter([1.0, 0.5])
+    acc = AccumulatingPlotter(name="off_loss", fetch=lambda: next(vals))
+    acc.run()
+    acc.run()
+    assert acc.values == [1.0, 0.5]
+    assert os.path.exists(acc.path())
+
+
+def test_render_false_still_accumulates(tmp_path):
+    """render=False plotters keep their raw series (for tests/notebooks)
+    without writing any file."""
+    from znicz_tpu.plotting_units import AccumulatingPlotter
+
+    root.common.dirs.plots = str(tmp_path)
+    vals = iter([2.0, 1.0])
+    acc = AccumulatingPlotter(name="noren", fetch=lambda: next(vals),
+                              render=False)
+    acc.run()
+    acc.run()
+    assert acc.values == [2.0, 1.0]
+    assert not os.path.exists(acc.path())
+
+
+def test_client_renders_all_plotter_kinds(tmp_path):
+    """Every plotter kind round-trips snapshot -> client render (in-proc
+    client; the subprocess path is covered above)."""
+    from znicz_tpu.graphics import GraphicsClient
+    from znicz_tpu.memory import Array
+    from znicz_tpu import plotting_units as pu
+
+    rng = np.random.default_rng(3)
+
+    class StubSOM:                         # KohonenHits only reads these
+        hits = Array(rng.integers(0, 9, size=(12,)).astype(np.int32))
+        sy, sx, total = 3, 4, 36
+
+    plotters = [
+        pu.AccumulatingPlotter(name="k_acc", fetch=iter([1.0]).__next__),
+        pu.Weights2D(name="k_w", source=Array(rng.normal(
+            size=(4, 9)).astype(np.float32)), sample_shape=(3, 3)),
+        pu.MatrixPlotter(name="k_m", fetch=lambda: np.eye(3)),
+        pu.KohonenHits(name="k_som", forward=StubSOM()),
+        pu.MultiHistogram(name="k_h", source=Array(rng.normal(
+            size=(50,)).astype(np.float32))),
+    ]
+    client = GraphicsClient.__new__(GraphicsClient)   # render() only
+    client.out_dir = str(tmp_path)
+    for p in plotters:
+        payload = {"kind": "figure", "cls": type(p).__name__,
+                   "name": p.name, "data": p.snapshot()}
+        import pickle
+
+        payload = pickle.loads(pickle.dumps(payload))  # the wire trip
+        path = client.render(payload)
+        assert path is not None and os.path.exists(path), p.name

@@ -1,0 +1,171 @@
+"""Live plot streaming (rebuild of ``veles/graphics_server.py`` +
+``graphics_client.py``, SURVEY.md §2.1 "Graphics" / L9).
+
+The reference published matplotlib figures from plot units over ZMQ pub/sub
+to a separate client process that rendered them live.  The rebuild streams
+each plotter's *data snapshot* (not a pickled figure): the client
+reconstructs the figure with the very same ``Plotter.draw`` renderer the
+offline path uses, so there is exactly one renderer per figure kind.
+
+  - ``GraphicsServer``: process-wide XPUB publisher.  XPUB (not PUB) so
+    ``wait_for_subscribers`` can see subscription handshakes and tests/
+    launchers can avoid the classic pub/sub slow-joiner message loss.
+  - ``GraphicsClient``: SUB loop rendering payloads to PNGs in an output
+    directory; run as ``python -m znicz_tpu.graphics <endpoint> <outdir>``.
+  - Plot units publish automatically whenever a server is active (see
+    ``plotting_units.Plotter.run``), degrading gracefully to offline PNG
+    rendering when none is.
+
+Payloads are pickled dicts ``{"kind": "figure", "cls": <Plotter subclass
+name>, "name": <unit name>, "data": {plain arrays/scalars}}`` plus a
+``{"kind": "end"}`` sentinel.  Transport is trusted-local (pickle over a
+loopback/ICI-side socket), matching the reference's model.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Optional
+
+_server: Optional["GraphicsServer"] = None
+
+
+class GraphicsServer:
+    """XPUB publisher for plotter snapshots.  ``start()`` installs the
+    process-wide instance that ``plotting_units.Plotter`` publishes to."""
+
+    def __init__(self, endpoint: str = "tcp://127.0.0.1:*"):
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.XPUB)
+        self._sock.bind(endpoint)
+        self.endpoint = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
+        self._subscribers = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def start(cls, endpoint: str = "tcp://127.0.0.1:*") -> "GraphicsServer":
+        global _server
+        if _server is None:
+            _server = cls(endpoint)
+        return _server
+
+    @classmethod
+    def active(cls) -> Optional["GraphicsServer"]:
+        return _server
+
+    @classmethod
+    def stop(cls) -> None:
+        global _server
+        if _server is not None:
+            _server.publish({"kind": "end"})
+            _server.close()
+            _server = None
+
+    def close(self) -> None:
+        self._sock.close(linger=500)
+
+    # -- pub side ------------------------------------------------------------
+
+    def _pump_subscriptions(self, timeout_ms: int = 0) -> None:
+        import zmq
+
+        while self._sock.poll(timeout_ms, zmq.POLLIN):
+            msg = self._sock.recv()
+            if msg[:1] == b"\x01":
+                self._subscribers += 1
+            elif msg[:1] == b"\x00":
+                self._subscribers -= 1
+            timeout_ms = 0
+
+    def wait_for_subscribers(self, n: int = 1, timeout: float = 10.0) -> bool:
+        """Block until >= n subscribers have joined (slow-joiner guard)."""
+        deadline = time.monotonic() + timeout
+        while self._subscribers < n:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            self._pump_subscriptions(int(left * 1000))
+        return True
+
+    def publish(self, payload: dict) -> None:
+        self._pump_subscriptions()
+        self._sock.send(pickle.dumps(payload, pickle.HIGHEST_PROTOCOL))
+
+
+class GraphicsClient:
+    """Receives plotter snapshots and renders PNGs via the plotter classes'
+    own ``draw`` renderers."""
+
+    def __init__(self, endpoint: str, out_dir: str):
+        import zmq
+
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.SUB)
+        self._sock.connect(endpoint)
+        self._sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self.received = 0
+
+    def render(self, payload: dict) -> Optional[str]:
+        from znicz_tpu import plotting_units
+
+        cls = getattr(plotting_units, payload["cls"], None)
+        if cls is None or not issubclass(cls, plotting_units.Plotter):
+            return None
+        path = os.path.join(self.out_dir, f"{payload['name']}.png")
+        cls.render_png(payload["data"], path)
+        return path
+
+    def run(self, max_figures: int = 0, timeout: float = 0.0) -> int:
+        """Render until the ``end`` sentinel (or limits); returns count."""
+        import zmq
+
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._sock.poll(int(left * 1000),
+                                                    zmq.POLLIN):
+                    break
+            payload = pickle.loads(self._sock.recv())
+            if payload.get("kind") == "end":
+                break
+            if payload.get("kind") == "figure":
+                if self.render(payload) is not None:
+                    self.received += 1
+                    if max_figures and self.received >= max_figures:
+                        break
+        return self.received
+
+    def close(self) -> None:
+        self._sock.close(linger=0)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="znicz-tpu live graphics client")
+    parser.add_argument("endpoint")
+    parser.add_argument("out_dir")
+    parser.add_argument("--max-figures", type=int, default=0)
+    parser.add_argument("--timeout", type=float, default=0.0)
+    args = parser.parse_args(argv)
+    client = GraphicsClient(args.endpoint, args.out_dir)
+    try:
+        count = client.run(max_figures=args.max_figures,
+                           timeout=args.timeout)
+    finally:
+        client.close()
+    print(f"rendered {count} figures -> {args.out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

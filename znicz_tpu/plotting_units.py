@@ -2,14 +2,20 @@
 ``znicz/nn_plotting_units.py``).
 
 The reference streamed live matplotlib figures from plot units to a separate
-``GraphicsClient`` process over ZMQ pub/sub.  On a headless TPU host the
-rebuild renders the same figures *offline*: each plotter is an ordinary unit
-gated to epoch boundaries that writes a PNG under
-``root.common.dirs.plots`` (plus keeps the raw series on itself for tests /
-notebooks).  The figure set mirrors the reference: error curves
-(AccumulatingPlotter), weight tiles (Weights2D), confusion matrix
-(MatrixPlotter), SOM hit maps (KohonenHits), value histograms
-(MultiHistogram).
+``GraphicsClient`` process over ZMQ pub/sub.  The rebuild keeps BOTH modes
+with a single renderer per figure kind:
+
+  - each plotter is ``snapshot()`` (gather plain data) + static
+    ``draw(plt, **data)`` (pure renderer);
+  - when a ``graphics.GraphicsServer`` is active, ``run`` publishes the
+    snapshot — a separate ``GraphicsClient`` process re-renders it live with
+    the same ``draw``;
+  - otherwise ``run`` renders offline to ``<root.common.dirs.plots>/
+    <name>.png`` (headless TPU-host default).
+
+The figure set mirrors the reference: error curves (AccumulatingPlotter),
+weight tiles (Weights2D), confusion matrix (MatrixPlotter), SOM hit maps
+(KohonenHits), value histograms (MultiHistogram).
 """
 
 from __future__ import annotations
@@ -32,41 +38,61 @@ def _plots_dir() -> str:
 
 
 class Plotter(Unit):
-    """Base: renders into ``<plots>/<name>.png`` via headless matplotlib."""
+    """Base: gathers a plain-data ``snapshot`` and either streams it to the
+    active ``GraphicsServer`` or renders it into ``<plots>/<name>.png``."""
 
     def __init__(self, workflow=None, name=None, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.render = kwargs.get("render", True)
 
-    def _figure(self):
+    def path(self) -> str:
+        return os.path.join(_plots_dir(), f"{self.name}.png")
+
+    def snapshot(self) -> dict:
+        """Plain arrays/scalars for ``draw`` — must be picklable."""
+        raise NotImplementedError
+
+    @staticmethod
+    def draw(plt, **data) -> None:
+        """Pure renderer; shared verbatim by offline run and live client."""
+        raise NotImplementedError
+
+    @classmethod
+    def render_png(cls, data: dict, path: str) -> None:
+        """THE figure scaffolding (backend, size, save options) — shared by
+        the offline path and the live GraphicsClient so they cannot
+        diverge."""
         import matplotlib
 
         matplotlib.use("Agg", force=False)
         import matplotlib.pyplot as plt
 
-        return plt
-
-    def path(self) -> str:
-        return os.path.join(_plots_dir(), f"{self.name}.png")
-
-    def redraw(self, plt) -> None:
-        raise NotImplementedError
-
-    def run(self):
-        if not self.render:
-            return
-        plt = self._figure()
         fig = plt.figure(figsize=(6, 4), dpi=96)
         try:
-            self.redraw(plt)
-            fig.savefig(self.path(), bbox_inches="tight")
+            cls.draw(plt, **data)
+            fig.savefig(path, bbox_inches="tight")
         finally:
             plt.close(fig)
 
+    def run(self):
+        # snapshot() BEFORE the render gate: accumulating plotters keep
+        # their raw series for tests/notebooks even with render=False
+        data = self.snapshot()
+        if not self.render:
+            return
+        from znicz_tpu.graphics import GraphicsServer
+
+        server = GraphicsServer.active()
+        if server is not None:
+            server.publish({"kind": "figure", "cls": type(self).__name__,
+                            "name": self.name, "data": data})
+            return
+        self.render_png(data, self.path())
+
 
 class AccumulatingPlotter(Plotter):
-    """Error/loss curve: appends ``input`` (a float, linked e.g. to a
-    decision epoch metric via a fetch callable) every run."""
+    """Error/loss curve: appends ``fetch()`` (a float, e.g. a decision epoch
+    metric) every run."""
 
     def __init__(self, workflow=None, name=None, fetch=None, ylabel="value",
                  **kwargs):
@@ -75,15 +101,16 @@ class AccumulatingPlotter(Plotter):
         self.ylabel = ylabel
         self.values: List[float] = []
 
-    def run(self):
+    def snapshot(self) -> dict:
         if self.fetch is not None:
             self.values.append(float(self.fetch()))
-        super().run()
+        return {"values": list(self.values), "ylabel": self.ylabel}
 
-    def redraw(self, plt):
-        plt.plot(self.values, marker="o", ms=3)
+    @staticmethod
+    def draw(plt, values=(), ylabel="value"):
+        plt.plot(values, marker="o", ms=3)
         plt.xlabel("epoch")
-        plt.ylabel(self.ylabel)
+        plt.ylabel(ylabel)
         plt.grid(True, alpha=0.3)
 
 
@@ -99,10 +126,15 @@ class Weights2D(Plotter):
         self.sample_shape = sample_shape   # e.g. (28, 28)
         self.limit = int(limit)
 
-    def redraw(self, plt):
+    def snapshot(self) -> dict:
         w = np.asarray(self.source.map_read())
-        w = w.reshape(w.shape[0], -1)[:self.limit]
-        shape = self.sample_shape or (
+        return {"weights": w.reshape(w.shape[0], -1)[:self.limit].copy(),
+                "sample_shape": self.sample_shape}
+
+    @staticmethod
+    def draw(plt, weights=None, sample_shape=None):
+        w = np.asarray(weights)
+        shape = tuple(sample_shape) if sample_shape else (
             int(np.sqrt(w.shape[1])), int(np.sqrt(w.shape[1])))
         n = w.shape[0]
         cols = int(np.ceil(np.sqrt(n)))
@@ -124,9 +156,12 @@ class MatrixPlotter(Plotter):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.fetch = fetch                 # () -> 2D array
 
-    def redraw(self, plt):
-        m = np.asarray(self.fetch())
-        plt.imshow(m, cmap="viridis")
+    def snapshot(self) -> dict:
+        return {"matrix": np.asarray(self.fetch())}
+
+    @staticmethod
+    def draw(plt, matrix=None):
+        plt.imshow(np.asarray(matrix), cmap="viridis")
         plt.colorbar()
         plt.xlabel("target")
         plt.ylabel("predicted")
@@ -139,12 +174,16 @@ class KohonenHits(Plotter):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.forward = forward             # KohonenForward
 
-    def redraw(self, plt):
+    def snapshot(self) -> dict:
         f = self.forward
-        hits = np.asarray(f.hits.map_read()).reshape(f.sy, f.sx)
-        plt.imshow(hits, cmap="hot")
+        return {"hits": np.asarray(f.hits.map_read()).reshape(f.sy, f.sx),
+                "total": int(f.total)}
+
+    @staticmethod
+    def draw(plt, hits=None, total=0):
+        plt.imshow(np.asarray(hits), cmap="hot")
         plt.colorbar()
-        plt.title(f"hits (total {f.total})")
+        plt.title(f"hits (total {total})")
 
 
 class MultiHistogram(Plotter):
@@ -156,7 +195,11 @@ class MultiHistogram(Plotter):
         self.source = source
         self.bins = int(bins)
 
-    def redraw(self, plt):
-        vals = np.asarray(self.source.map_read()).reshape(-1)
-        plt.hist(vals, bins=self.bins)
+    def snapshot(self) -> dict:
+        return {"values": np.asarray(self.source.map_read()).reshape(-1),
+                "bins": self.bins}
+
+    @staticmethod
+    def draw(plt, values=None, bins=50):
+        plt.hist(np.asarray(values), bins=int(bins))
         plt.grid(True, alpha=0.3)
