@@ -14,13 +14,23 @@ keeps the surface:
     writes each individual's values into the config tree and calls
     ``evaluate() -> fitness`` (lower is better: final validation error).
 
-Runs are sequential here (one accelerator); the reference's multiprocess
-evaluation maps onto launching independent runs per chip at the CLI level.
+Evaluation modes (the reference fanned individuals out to a multiprocess
+pool — SURVEY.md §2.1 "Genetics"):
+
+  - in-process sequential (default): ``evaluate()`` runs in this process;
+  - multiprocess: pass ``subprocess_evaluator=SubprocessEvaluator(...)`` and
+    ``workers=N`` — each individual becomes an independent launcher run
+    (``python -m znicz_tpu <workflow> root.x=... --fitness``) in its own
+    process with its own device/config state, up to N at a time.  With a
+    single-claim TPU keep N=1 or point workers at CPU via ``env``.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Tuple
+import os
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -54,12 +64,88 @@ def find_tunes(cfg: Config, prefix: str = "") -> List[Tuple[str, Tune]]:
     return out
 
 
+class SubprocessEvaluator:
+    """Evaluates one chromosome as an independent ``python -m znicz_tpu``
+    run, passing the chromosome as dotted config overrides and reading the
+    fitness from the launcher's ``--fitness`` JSON line.
+
+    ``prefix`` maps the optimizer's tune paths (relative to its
+    ``config_root``) onto the global config tree, e.g. ``"root.mnist"``.
+    """
+
+    def __init__(self, workflow: str, config: str = "",
+                 overrides: Sequence[str] = (), prefix: str = "root",
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None, timeout: float = 3600.0):
+        self.workflow = workflow
+        self.config = config
+        self.overrides = list(overrides)
+        self.prefix = prefix.rstrip(".")
+        self.env = env
+        # 'python -m znicz_tpu' must resolve regardless of the caller's cwd:
+        # default to the directory containing the znicz_tpu package
+        if cwd is None:
+            import znicz_tpu
+
+            cwd = os.path.dirname(os.path.dirname(
+                os.path.abspath(znicz_tpu.__file__)))
+        self.cwd = cwd
+        self.timeout = float(timeout)
+
+    def launch(self, assignments: Dict[str, float]) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "znicz_tpu", self.workflow]
+        if self.config:
+            cmd.append(self.config)
+        cmd += self.overrides
+        cmd += [f"{self.prefix}.{path}={value!r}"
+                for path, value in assignments.items()]
+        cmd.append("--fitness")
+        env = dict(os.environ, **self.env) if self.env else None
+        return subprocess.Popen(cmd, env=env, cwd=self.cwd,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    def fitness_from(self, proc: subprocess.Popen) -> float:
+        import json
+
+        try:
+            stdout, stderr = proc.communicate(timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise RuntimeError(
+                f"genetics individual timed out after {self.timeout}s")
+        if proc.returncode:
+            raise RuntimeError(
+                f"genetics individual failed (rc={proc.returncode}):\n"
+                f"{stderr[-2000:]}")
+        for line in reversed(stdout.strip().splitlines()):
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if "genetics_fitness" in record:
+                return float(record["genetics_fitness"])
+        raise RuntimeError("launcher printed no genetics_fitness line")
+
+
 class GeneticsOptimizer:
-    def __init__(self, evaluate: Callable[[], float], config_root: Config,
+    def __init__(self, evaluate: Optional[Callable[[], float]] = None,
+                 config_root: Config = None,
                  generations: int = 5, population: int = 8,
-                 mutation_rate: float = 0.25, elite: int = 1):
+                 mutation_rate: float = 0.25, elite: int = 1,
+                 workers: int = 1,
+                 subprocess_evaluator: Optional[SubprocessEvaluator] = None):
+        if evaluate is None and subprocess_evaluator is None:
+            raise ValueError("need evaluate() or a subprocess_evaluator")
+        if config_root is None:
+            raise ValueError("config_root (the Config subtree holding the "
+                             "Tune leaves) is required")
         self.evaluate = evaluate
         self.config_root = config_root
+        self.workers = max(1, int(workers))
+        self.subprocess_evaluator = subprocess_evaluator
+        self.max_parallel = 0              # observed batch width (tests)
         self.tunes = find_tunes(config_root)
         if not self.tunes:
             raise ValueError("no Tune leaves found under the config root")
@@ -88,6 +174,44 @@ class GeneticsOptimizer:
     def _fitness(self, chromo: np.ndarray) -> float:
         self._apply(chromo)
         return float(self.evaluate())
+
+    def _assignments(self, chromo: np.ndarray) -> Dict[str, float]:
+        return {path: float(v) for (path, _), v in zip(self.tunes, chromo)}
+
+    def _score_population(self, pop):
+        """Fill in missing fitnesses — sequential in-process, or batches of
+        up to ``workers`` concurrent launcher subprocesses."""
+        import logging
+
+        pending = [(i, c) for i, (c, f) in enumerate(pop) if f is None]
+        fits: Dict[int, float] = {}
+        evaluator = self.subprocess_evaluator
+        if evaluator is not None:
+            log = logging.getLogger("genetics")
+            for start in range(0, len(pending), self.workers):
+                batch = pending[start:start + self.workers]
+                procs = [(i, evaluator.launch(self._assignments(c)))
+                         for i, c in batch]
+                self.max_parallel = max(self.max_parallel, len(procs))
+                try:
+                    for i, proc in procs:
+                        try:
+                            fits[i] = evaluator.fitness_from(proc)
+                        except RuntimeError as exc:
+                            # one bad individual must not abort the GA (or
+                            # leak its batch): penalize and move on
+                            log.warning("individual %d failed: %s", i, exc)
+                            fits[i] = float("inf")
+                finally:
+                    for _, proc in procs:       # hard-failure path cleanup
+                        if proc.poll() is None:
+                            proc.kill()
+                            proc.communicate()
+        else:
+            for i, c in pending:
+                fits[i] = self._fitness(c)
+        return [(c, fits[i] if f is None else f)
+                for i, (c, f) in enumerate(pop)]
 
     # -- GA operators ----------------------------------------------------------
 
@@ -120,8 +244,7 @@ class GeneticsOptimizer:
         while len(pop) < self.population_size:
             pop.append((self._random_chromo(), None))
         for gen in range(self.generations):
-            scored = [(c, f if f is not None else self._fitness(c))
-                      for c, f in pop]
+            scored = self._score_population(pop)
             scored.sort(key=lambda cf: cf[1])
             if scored[0][1] < self.best_fitness:
                 self.best_fitness = scored[0][1]
@@ -133,5 +256,8 @@ class GeneticsOptimizer:
                                         self._tournament(scored))
                 nxt.append((self._mutate(child), None))
             pop = nxt
+        if self.best_chromo is None:      # every individual was penalized
+            raise RuntimeError("genetics: every individual failed; see the "
+                               "'genetics' logger for per-run errors")
         self._apply(self.best_chromo)     # leave config at the winner
         return self.best_chromo, self.best_fitness

@@ -122,17 +122,24 @@ class GraphicsClient:
         cls.render_png(payload["data"], path)
         return path
 
-    def run(self, max_figures: int = 0, timeout: float = 0.0) -> int:
-        """Render until the ``end`` sentinel (or limits); returns count."""
+    def run(self, max_figures: int = 0, timeout: float = 0.0,
+            idle_timeout: float = 600.0) -> int:
+        """Render until the ``end`` sentinel (or limits); returns count.
+        ``idle_timeout`` bounds every recv so the client always exits even
+        when the publisher dies without sending the sentinel (SUB sockets
+        wait for reconnection forever otherwise)."""
         import zmq
 
         deadline = time.monotonic() + timeout if timeout else None
         while True:
+            wait = idle_timeout if idle_timeout else None
             if deadline is not None:
                 left = deadline - time.monotonic()
-                if left <= 0 or not self._sock.poll(int(left * 1000),
-                                                    zmq.POLLIN):
-                    break
+                wait = left if wait is None else min(left, wait)
+            if wait is not None and (
+                    wait <= 0
+                    or not self._sock.poll(int(wait * 1000), zmq.POLLIN)):
+                break
             payload = pickle.loads(self._sock.recv())
             if payload.get("kind") == "end":
                 break
@@ -156,11 +163,15 @@ def main(argv=None) -> int:
     parser.add_argument("out_dir")
     parser.add_argument("--max-figures", type=int, default=0)
     parser.add_argument("--timeout", type=float, default=0.0)
+    parser.add_argument("--idle-timeout", type=float, default=600.0,
+                        help="exit after this long with no messages "
+                             "(guards against a dead publisher; 0 = never)")
     args = parser.parse_args(argv)
     client = GraphicsClient(args.endpoint, args.out_dir)
     try:
         count = client.run(max_figures=args.max_figures,
-                           timeout=args.timeout)
+                           timeout=args.timeout,
+                           idle_timeout=args.idle_timeout)
     finally:
         client.close()
     print(f"rendered {count} figures -> {args.out_dir}")

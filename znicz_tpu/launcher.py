@@ -59,6 +59,9 @@ class Launcher:
         parser.add_argument("--seed", type=int, default=None)
         parser.add_argument("--workflow-graph", default="",
                             help="write the control graph as graphviz dot")
+        parser.add_argument("--fitness", action="store_true",
+                            help="print a final JSON line with the run's "
+                                 "fitness (genetics subprocess evaluation)")
         parser.add_argument("--list", action="store_true",
                             help="list bundled samples")
         self.args = parser.parse_args(argv)
@@ -102,6 +105,21 @@ class Launcher:
             with open(args.workflow_graph, "w") as f:
                 f.write(wf.generate_graph())
             print(f"workflow graph -> {args.workflow_graph}")
+        if args.fitness:
+            import json
+
+            fit = None
+            decision = getattr(wf, "decision", None)
+            if decision is not None:
+                fit = getattr(decision, "best_metric", None)
+                if fit is None and getattr(decision, "epoch_qerror", None):
+                    fit = decision.epoch_qerror[-1]
+            if fit is None:
+                print("error: workflow exposes no fitness "
+                      "(decision.best_metric / epoch_qerror)",
+                      file=sys.stderr)
+                return 3
+            print(json.dumps({"genetics_fitness": float(fit)}), flush=True)
         return 0
 
 
