@@ -30,6 +30,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -101,15 +102,21 @@ class SubprocessEvaluator:
                 for path, value in assignments.items()]
         cmd.append("--fitness")
         env = dict(os.environ, **self.env) if self.env else None
-        return subprocess.Popen(cmd, env=env, cwd=self.cwd,
+        proc = subprocess.Popen(cmd, env=env, cwd=self.cwd,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
+        # the timeout budget runs from LAUNCH, so a batch of hung
+        # individuals clears in ~timeout total, not workers x timeout
+        proc.deadline = time.monotonic() + self.timeout
+        return proc
 
     def fitness_from(self, proc: subprocess.Popen) -> float:
         import json
 
+        left = getattr(proc, "deadline",
+                       time.monotonic() + self.timeout) - time.monotonic()
         try:
-            stdout, stderr = proc.communicate(timeout=self.timeout)
+            stdout, stderr = proc.communicate(timeout=max(0.0, left))
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
@@ -124,7 +131,7 @@ class SubprocessEvaluator:
                 record = json.loads(line)
             except ValueError:
                 continue
-            if "genetics_fitness" in record:
+            if isinstance(record, dict) and "genetics_fitness" in record:
                 return float(record["genetics_fitness"])
         raise RuntimeError("launcher printed no genetics_fitness line")
 
@@ -190,10 +197,14 @@ class GeneticsOptimizer:
             log = logging.getLogger("genetics")
             for start in range(0, len(pending), self.workers):
                 batch = pending[start:start + self.workers]
-                procs = [(i, evaluator.launch(self._assignments(c)))
-                         for i, c in batch]
-                self.max_parallel = max(self.max_parallel, len(procs))
+                procs = []
                 try:
+                    # launch INSIDE the try: a failed launch mid-batch must
+                    # still reap the already-started siblings
+                    for i, c in batch:
+                        procs.append((i, evaluator.launch(
+                            self._assignments(c))))
+                    self.max_parallel = max(self.max_parallel, len(procs))
                     for i, proc in procs:
                         try:
                             fits[i] = evaluator.fitness_from(proc)

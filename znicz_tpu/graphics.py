@@ -123,13 +123,17 @@ class GraphicsClient:
         return path
 
     def run(self, max_figures: int = 0, timeout: float = 0.0,
-            idle_timeout: float = 600.0) -> int:
+            idle_timeout: Optional[float] = None) -> int:
         """Render until the ``end`` sentinel (or limits); returns count.
         ``idle_timeout`` bounds every recv so the client always exits even
         when the publisher dies without sending the sentinel (SUB sockets
-        wait for reconnection forever otherwise)."""
+        wait for reconnection forever otherwise).  Default: 600s when no
+        overall ``timeout`` is given, else disabled — an explicit timeout
+        must never be silently capped by the idle guard."""
         import zmq
 
+        if idle_timeout is None:
+            idle_timeout = 0.0 if timeout else 600.0
         deadline = time.monotonic() + timeout if timeout else None
         while True:
             wait = idle_timeout if idle_timeout else None
@@ -163,9 +167,10 @@ def main(argv=None) -> int:
     parser.add_argument("out_dir")
     parser.add_argument("--max-figures", type=int, default=0)
     parser.add_argument("--timeout", type=float, default=0.0)
-    parser.add_argument("--idle-timeout", type=float, default=600.0,
+    parser.add_argument("--idle-timeout", type=float, default=None,
                         help="exit after this long with no messages "
-                             "(guards against a dead publisher; 0 = never)")
+                             "(default: 600 when no --timeout, else off; "
+                             "0 = never)")
     args = parser.parse_args(argv)
     client = GraphicsClient(args.endpoint, args.out_dir)
     try:

@@ -18,7 +18,7 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
-def provision_cpu_devices(n: int) -> None:
+def provision_cpu_devices(n: int, verify: bool = True) -> None:
     """Pin this process to a CPU platform exposing >= n virtual devices.
 
     Safe to call repeatedly; an existing forced count is only ever raised,
@@ -26,6 +26,10 @@ def provision_cpu_devices(n: int) -> None:
     sitecustomize at interpreter start and pins jax_platforms=axon via
     jax.config (which overrides the env var); its tunnel is single-claim, so
     we deregister the factory before jax can claim it for a CPU-only run.
+
+    ``verify=False`` skips the device-count check, leaving backends
+    UNinitialized — required before ``jax.distributed.initialize`` (which
+    must precede the first backend creation).
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -43,6 +47,8 @@ def provision_cpu_devices(n: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    if not verify:
+        return
     # XLA parses the flags at FIRST client creation only: if backends were
     # already initialized with fewer devices, the env rewrite above silently
     # did nothing — fail here with the real cause instead of a confusing
