@@ -78,3 +78,22 @@ def test_launcher_fitness_flag(tmp_path):
     line = [ln for ln in proc.stdout.strip().splitlines()
             if "genetics_fitness" in ln][-1]
     assert json.loads(line)["genetics_fitness"] >= 0.0
+
+
+def test_launcher_fitness_nonfinite_is_no_fitness(tmp_path):
+    """A run whose best_metric never left inf must exit 3 with no
+    genetics_fitness line (json 'Infinity' is not RFC JSON)."""
+    import subprocess
+
+    # 0 epochs: decision never observes a validation metric
+    proc = subprocess.run(
+        [sys.executable, "-m", "znicz_tpu", "wine",
+         "root.wine.decision.max_epochs=0", "--fitness"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    if proc.returncode == 0:
+        # some samples still record a finite metric after epoch 0; the
+        # contract under test is only: never print non-finite fitness
+        assert "Infinity" not in proc.stdout
+    else:
+        assert proc.returncode == 3, proc.stderr[-2000:]
+        assert "genetics_fitness" not in proc.stdout

@@ -82,6 +82,20 @@ def test_render_false_still_accumulates(tmp_path):
     assert not os.path.exists(acc.path())
 
 
+def test_client_refuses_non_loopback_endpoint(tmp_path):
+    """Pickled payloads from an arbitrary host would be code execution;
+    the client must refuse non-loopback endpoints unless overridden."""
+    import pytest
+
+    from znicz_tpu.graphics import GraphicsClient, _is_loopback
+
+    with pytest.raises(ValueError, match="loopback"):
+        GraphicsClient("tcp://198.51.100.7:5555", str(tmp_path))
+    assert _is_loopback("tcp://127.0.0.1:9000")
+    assert _is_loopback("ipc:///tmp/sock")
+    assert not _is_loopback("tcp://[2001:db8::1]:9000")
+
+
 def test_client_renders_all_plotter_kinds(tmp_path):
     """Every plotter kind round-trips snapshot -> client render (in-proc
     client; the subprocess path is covered above)."""

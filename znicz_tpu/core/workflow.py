@@ -90,14 +90,19 @@ class Workflow(Unit):
             device = Device.auto()
         self.device = device
         pending = [u for u in self.units if not u.is_initialized]
-        retry: List[Unit] = []
+        retry: List[tuple] = []
         for unit in pending:
             try:
                 unit.initialize(device=device, **kwargs)
-            except AttributeError:
-                retry.append(unit)
-        for unit in retry:
-            unit.initialize(device=device, **kwargs)
+            except AttributeError as exc:
+                retry.append((unit, exc))
+        for unit, first_exc in retry:
+            try:
+                unit.initialize(device=device, **kwargs)
+            except Exception as exc:
+                # A genuinely broken unit fails both passes; surface the
+                # first-pass error as the cause instead of hiding it.
+                raise exc from first_exc
 
     def run(self) -> None:
         """Run the control graph until EndPoint fires (or nothing is ready)."""

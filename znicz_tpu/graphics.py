@@ -97,13 +97,33 @@ class GraphicsServer:
         self._sock.send(pickle.dumps(payload, pickle.HIGHEST_PROTOCOL))
 
 
+def _is_loopback(endpoint: str) -> bool:
+    """True for ipc:// / inproc:// endpoints and tcp:// on a loopback host."""
+    if endpoint.startswith(("ipc://", "inproc://")):
+        return True
+    if endpoint.startswith("tcp://"):
+        host = endpoint[len("tcp://"):].rsplit(":", 1)[0].strip("[]")
+        return host in ("127.0.0.1", "localhost", "::1", "0.0.0.0")
+    return False
+
+
 class GraphicsClient:
     """Receives plotter snapshots and renders PNGs via the plotter classes'
     own ``draw`` renderers."""
 
-    def __init__(self, endpoint: str, out_dir: str):
+    def __init__(self, endpoint: str, out_dir: str,
+                 allow_remote: bool = False):
         import zmq
 
+        # Payloads are unpickled (same-host trusted IPC, like the reference's
+        # twisted pickle streams).  Unpickling data from a non-loopback peer
+        # would be arbitrary code execution, so refuse unless explicitly
+        # overridden.
+        if not allow_remote and not _is_loopback(endpoint):
+            raise ValueError(
+                f"GraphicsClient endpoint {endpoint!r} is not loopback; "
+                "payloads are pickled (code-execution risk from untrusted "
+                "publishers). Pass allow_remote=True only for trusted hosts.")
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
         self._ctx = zmq.Context.instance()

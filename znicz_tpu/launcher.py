@@ -114,8 +114,12 @@ class Launcher:
                 fit = getattr(decision, "best_metric", None)
                 if fit is None and getattr(decision, "epoch_qerror", None):
                     fit = decision.epoch_qerror[-1]
-            if fit is None:
-                print("error: workflow exposes no fitness "
+            import math
+
+            if fit is None or not math.isfinite(float(fit)):
+                # inf best_metric means no epoch ever improved — emitting
+                # json 'Infinity' would be non-RFC JSON, so report no fitness.
+                print("error: workflow exposes no finite fitness "
                       "(decision.best_metric / epoch_qerror)",
                       file=sys.stderr)
                 return 3

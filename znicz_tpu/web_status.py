@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import html
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -44,7 +45,9 @@ class WebStatus:
             import jax
 
             out["devices"] = [str(d) for d in jax.devices()]
-        except Exception:
+        except Exception as exc:       # no backend reachable: degrade visibly
+            logging.getLogger("web_status").warning(
+                "device enumeration failed: %r", exc)
             out["devices"] = []
         for wf in self.workflows:
             info = {"name": wf.name, "stopped": bool(wf.stopped),
