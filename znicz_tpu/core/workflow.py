@@ -155,6 +155,15 @@ class Workflow(Unit):
                 continue
             lines.append(f"{u.name:<32}{u.run_count:>8}{u.run_time:>12.4f}"
                          f"{100.0 * u.run_time / total:>8.1f}")
+        fused = getattr(self, "fused_stats", None)
+        if fused and fused.get("wall_s"):
+            lines.append(
+                f"fused: {fused['train_steps']} train + "
+                f"{fused['eval_steps']} eval steps in "
+                f"{fused['wall_s']:.3f}s  "
+                f"({fused['steps_per_sec']} steps/s, "
+                f"{fused['img_per_sec']} img/s, "
+                f"last {fused['last_step_ms']} ms)")
         table = "\n".join(lines)
         self.info("unit timing:\n%s", table)
         return table

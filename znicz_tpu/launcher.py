@@ -59,6 +59,9 @@ class Launcher:
         parser.add_argument("--seed", type=int, default=None)
         parser.add_argument("--workflow-graph", default="",
                             help="write the control graph as graphviz dot")
+        parser.add_argument("--profile", default="",
+                            help="capture a jax.profiler trace of the whole "
+                                 "run into this directory")
         parser.add_argument("--fitness", action="store_true",
                             help="print a final JSON line with the run's "
                                  "fitness (genetics subprocess evaluation)")
@@ -100,7 +103,14 @@ class Launcher:
         sig = inspect.signature(mod.run)
         if "snapshot" in sig.parameters and args.snapshot:
             kwargs["snapshot"] = args.snapshot
-        wf = mod.run(**kwargs)
+        if args.profile:
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                wf = mod.run(**kwargs)
+            print(f"profiler trace -> {args.profile}/")
+        else:
+            wf = mod.run(**kwargs)
         if args.workflow_graph and wf is not None:
             with open(args.workflow_graph, "w") as f:
                 f.write(wf.generate_graph())

@@ -55,6 +55,11 @@ class Loader(Unit):
         self._shuffled_indices: Optional[np.ndarray] = None
         self._pos = 0
         self.samples_served = 0
+        #: fast path for fused training: run() advances the index state
+        #: machine but skips fill_minibatch (the fused step gathers on
+        #: device from original_data itself, so filling minibatch_data is
+        #: pure overhead — two extra dispatches per step)
+        self.indices_only = False
 
     # -- derived geometry -----------------------------------------------------
 
@@ -176,4 +181,5 @@ class Loader(Unit):
         self.epoch_ended = self.last_minibatch
         self._pos = end
         self.samples_served += count
-        self.fill_minibatch()
+        if not self.indices_only:
+            self.fill_minibatch()

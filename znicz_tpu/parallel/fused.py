@@ -71,9 +71,18 @@ class FusedTrainer:
                         f"({f.name}.{k} shares {seen[id(arr)]})")
                 seen[id(arr)] = f"{f.name}.{k}"
         self._train_step = None
+        self._train_scan = None
         self._eval_step = None
         self._key0 = prng.get("fused_trainer").jax_key(0)
         self.steps_done = 0
+        #: per-step timing accumulated by run() (SURVEY.md §5 Tracing —
+        #: the fast path reports like the unit path's timing table does);
+        #: surfaced by Workflow.print_stats and web_status /status.json
+        #: via ``workflow.fused_stats``
+        self.stats = {"train_steps": 0, "eval_steps": 0, "images": 0,
+                      "wall_s": 0.0, "steps_per_sec": 0.0,
+                      "img_per_sec": 0.0, "last_step_ms": 0.0}
+        workflow.fused_stats = self.stats
         self.compute_dtype = (np.dtype("float32")
                               if root.common.engine.get("precision",
                                                         "float32")
@@ -219,46 +228,75 @@ class FusedTrainer:
                 return NamedSharding(mesh, P("model"))
         return NamedSharding(mesh, P())
 
+    def _step_core(self, params, velocities, hypers, dataset, targets, idx,
+                   batch_size, key):
+        """One pure train step (traced): gather -> fwd -> grads -> per-layer
+        sgd update.  Shared by the single-step jit and the scan chunk."""
+        import jax
+
+        data = jax.numpy.take(dataset, idx, axis=0)
+        tgt = jax.numpy.take(targets, idx, axis=0)
+        if self.mesh is not None:
+            # dataset stays replicated; the gathered minibatch is what
+            # shards over the data axis (XLA then keeps the whole
+            # fwd/bwd batch-sharded and psums the grads over ICI)
+            from znicz_tpu.parallel.mesh import data_sharding
+
+            shard = data_sharding(self.mesh)
+            data = jax.lax.with_sharding_constraint(data, shard)
+            tgt = jax.lax.with_sharding_constraint(tgt, shard)
+
+        def lf(p):
+            return self.loss_and_metrics(p, data, tgt, batch_size, key,
+                                         train=True)
+
+        grads, metrics = jax.grad(lf, has_aux=True)(params)
+        new_p, new_v = {}, {}
+        for name, layer_p in params.items():
+            lr, lrb, wd, wdb, l1l2, mom, momb, clip = hypers[name]
+            new_p[name], new_v[name] = {}, {}
+            for k, w in layer_p.items():
+                g = grads[name][k].astype("float32")
+                is_bias = (k == "bias")
+                new_p[name][k], new_v[name][k] = sgd_update(
+                    w, g, velocities[name][k],
+                    lr=(lrb if is_bias else lr),
+                    weights_decay=(wdb if is_bias else wd),
+                    l1_vs_l2=l1l2,
+                    momentum=(momb if is_bias else mom), clip=clip)
+        return new_p, new_v, metrics
+
     def make_train_step(self):
         """The step takes ``hypers`` as a traced argument so per-epoch lr
         adjustment (LearningRateAdjust) never recompiles."""
         import jax
 
-        def step(params, velocities, hypers, dataset, targets, idx,
-                 batch_size, key):
-            data = jax.numpy.take(dataset, idx, axis=0)
-            tgt = jax.numpy.take(targets, idx, axis=0)
-            if self.mesh is not None:
-                # dataset stays replicated; the gathered minibatch is what
-                # shards over the data axis (XLA then keeps the whole
-                # fwd/bwd batch-sharded and psums the grads over ICI)
-                from znicz_tpu.parallel.mesh import data_sharding
+        return jax.jit(self._step_core, donate_argnums=(0, 1))
 
-                shard = data_sharding(self.mesh)
-                data = jax.lax.with_sharding_constraint(data, shard)
-                tgt = jax.lax.with_sharding_constraint(tgt, shard)
+    def make_train_scan(self):
+        """K steps in ONE dispatch via ``lax.scan`` over stacked
+        (idx, batch_size, key) rows — K is static per (K,) shape.  Each
+        scanned step is the same ``_step_core`` with the same per-step keys
+        the sequential path would draw, so semantics are identical; what
+        changes is dispatch count, which dominates wall time on
+        high-latency links (tunneled TPU: ~20ms/dispatch vs ~5ms compute —
+        bench r3).  Metrics come back stacked, one per step."""
+        import jax
 
-            def lf(p):
-                return self.loss_and_metrics(p, data, tgt, batch_size, key,
-                                             train=True)
+        def chunk(params, velocities, hypers, dataset, targets, idx_mat,
+                  bs_vec, keys):
+            def body(carry, xs):
+                p, v = carry
+                idx, bs, key = xs
+                p, v, metrics = self._step_core(
+                    p, v, hypers, dataset, targets, idx, bs, key)
+                return (p, v), metrics
 
-            grads, metrics = jax.grad(lf, has_aux=True)(params)
-            new_p, new_v = {}, {}
-            for name, layer_p in params.items():
-                lr, lrb, wd, wdb, l1l2, mom, momb, clip = hypers[name]
-                new_p[name], new_v[name] = {}, {}
-                for k, w in layer_p.items():
-                    g = grads[name][k].astype("float32")
-                    is_bias = (k == "bias")
-                    new_p[name][k], new_v[name][k] = sgd_update(
-                        w, g, velocities[name][k],
-                        lr=(lrb if is_bias else lr),
-                        weights_decay=(wdb if is_bias else wd),
-                        l1_vs_l2=l1l2,
-                        momentum=(momb if is_bias else mom), clip=clip)
-            return new_p, new_v, metrics
+            (p, v), ms = jax.lax.scan(
+                body, (params, velocities), (idx_mat, bs_vec, keys))
+            return p, v, ms
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(chunk, donate_argnums=(0, 1))
 
     def make_eval_step(self):
         """Metrics-only step.  ``train`` is static: True replays the exact
@@ -281,11 +319,36 @@ class FusedTrainer:
 
     # -- the epoch driver ------------------------------------------------------
 
+    #: scan this many consecutive TRAIN steps per dispatch (the epoch tail
+    #: and eval minibatches always go one-at-a-time, preserving the
+    #: Decision's gd_skip semantics).  1 disables scanning.
+    scan_chunk = 8
+
+    def _advance(self):
+        """Advance the loader one minibatch and snapshot its state (the
+        fused path consumes index state only — ``indices_only``)."""
+        loader = self.loader
+        loader.run()
+        return {
+            "idx": np.array(loader.minibatch_indices.mem, np.int32),
+            "class": int(loader.minibatch_class),
+            "size": int(loader.minibatch_size),
+            "last_minibatch": bool(loader.last_minibatch),
+            "class_ended": bool(loader.class_ended),
+            "epoch_number": int(loader.epoch_number),
+        }
+
     def run(self) -> None:
         """Train until the decision completes, mirroring the loader's
         epoch/class state machine but with fused steps.  Feeds the Decision
         unit per-minibatch so its improvement/stop/log semantics (and the
-        snapshotter trigger) behave exactly like the unit path."""
+        snapshotter trigger) behave exactly like the unit path.
+
+        Consecutive non-tail TRAIN minibatches are executed as ONE
+        ``lax.scan`` dispatch of up to ``scan_chunk`` steps (identical math
+        and per-step keys; Decision is fed each scanned step's metrics in
+        order afterwards — it cannot flip ``complete`` mid-class, only at
+        the epoch tail, which always runs one-at-a-time)."""
         from znicz_tpu.loader.base import TRAIN
 
         wf = self.workflow
@@ -293,6 +356,8 @@ class FusedTrainer:
         if self._train_step is None:
             self._train_step = self.make_train_step()
             self._eval_step = self.make_eval_step()
+        if self._train_scan is None and self.scan_chunk > 1:
+            self._train_scan = self.make_train_scan()
         params = self.extract_params()
         velocities = self.extract_velocities()
         dataset = loader.original_data.devmem
@@ -317,57 +382,129 @@ class FusedTrainer:
             dataset = jax.device_put(dataset, repl)
             targets = jax.device_put(targets, repl)
 
-        def feed_decision(metrics):
+        def feed_decision(mb, metrics):
             loss, n_err, conf = metrics
-            decision.minibatch_class = loader.minibatch_class
-            decision.last_minibatch = loader.last_minibatch
-            decision.class_ended = loader.class_ended
-            decision.epoch_number = loader.epoch_number
+            decision.minibatch_class = mb["class"]
+            decision.last_minibatch = mb["last_minibatch"]
+            decision.class_ended = mb["class_ended"]
+            decision.epoch_number = mb["epoch_number"]
             decision.class_lengths = loader.class_lengths
-            decision.minibatch_size = int(loader.minibatch_size)
+            decision.minibatch_size = mb["size"]
             decision.minibatch_loss = float(loss)
             if hasattr(decision, "minibatch_n_err"):
                 decision.minibatch_n_err = int(n_err)
                 decision.confusion_matrix = np.asarray(conf)
             decision.run()
 
-        while not bool(decision.complete):
-            loader.run()                       # advances the state machine
-            idx = loader.minibatch_indices.devmem
-            if repl is not None:
-                import jax
-                idx = jax.device_put(idx, repl)
-            bs = np.int32(loader.minibatch_size)
-            is_train = (loader.minibatch_class == TRAIN)
-            if is_train and not loader.last_minibatch:
-                # complete can only flip at the epoch tail -> update freely
-                key = prng.get("fused_trainer").jax_key(self.steps_done)
-                params, velocities, metrics = self._train_step(
-                    params, velocities, self.hypers(), dataset, targets,
-                    idx, bs, key)
-                self.steps_done += 1
-                feed_decision(metrics)
-            elif is_train:
-                # epoch tail: metrics first, Decision rules, and the update
-                # is applied only if gd_skip stayed open (unit-path parity)
-                key = prng.get("fused_trainer").jax_key(self.steps_done)
-                metrics = self._eval_step(params, dataset, targets, idx, bs,
-                                          key, True)
-                feed_decision(metrics)
-                if not bool(decision.gd_skip):
-                    params, velocities, _ = self._train_step(
-                        params, velocities, self.hypers(), dataset, targets,
-                        idx, bs, key)
-                self.steps_done += 1
+        def account(n_steps, n_images, dt, is_train):
+            stats["wall_s"] += dt
+            stats["last_step_ms"] = round(dt / n_steps * 1e3, 3)
+            if is_train:
+                stats["train_steps"] += n_steps
+                stats["images"] += n_images
             else:
-                metrics = self._eval_step(params, dataset, targets, idx, bs,
-                                          self._key0, False)
-                feed_decision(metrics)
-            if bool(decision.epoch_ended):
-                self.writeback(params, velocities)
-                snap = getattr(wf, "snapshotter", None)
-                if snap is not None and not bool(snap.gate_skip):
-                    snap.epoch_number = decision.epoch_number
-                    snap.improved = decision.improved
-                    snap.run()
-        self.writeback(params, velocities)
+                stats["eval_steps"] += n_steps
+            total = stats["train_steps"] + stats["eval_steps"]
+            stats["steps_per_sec"] = round(total / stats["wall_s"], 2)
+            stats["img_per_sec"] = round(
+                stats["images"] / stats["wall_s"], 2)
+
+        def epoch_end_hook():
+            self.writeback(params, velocities)
+            snap = getattr(wf, "snapshotter", None)
+            if snap is not None and not bool(snap.gate_skip):
+                snap.epoch_number = decision.epoch_number
+                snap.improved = decision.improved
+                snap.run()
+
+        def put(x):
+            if repl is None:
+                return x
+            import jax
+
+            return jax.device_put(x, repl)
+
+        import time as _time
+
+        stats = self.stats
+        was_indices_only = loader.indices_only
+        loader.indices_only = True
+        pending = None                  # an advanced-but-unprocessed mb
+        try:
+            while not bool(decision.complete):
+                t_iter = _time.perf_counter()
+                mb = pending if pending is not None else self._advance()
+                pending = None
+                is_train = (mb["class"] == TRAIN)
+                if is_train and not mb["last_minibatch"]:
+                    # collect the segment of consecutive non-tail TRAIN
+                    # minibatches (they cannot flip `complete`) and run it
+                    # as one scan dispatch
+                    seg = [mb]
+                    max_seg = self.scan_chunk if self._train_scan else 1
+                    while len(seg) < max_seg:
+                        nxt = self._advance()
+                        if nxt["class"] == TRAIN and \
+                                not nxt["last_minibatch"]:
+                            seg.append(nxt)
+                        else:
+                            pending = nxt
+                            break
+                    gen = prng.get("fused_trainer")
+                    if len(seg) == 1:
+                        key = gen.jax_key(self.steps_done)
+                        params, velocities, metrics = self._train_step(
+                            params, velocities, self.hypers(), dataset,
+                            targets, put(seg[0]["idx"]),
+                            np.int32(seg[0]["size"]), key)
+                        stacked = [metrics]
+                    else:
+                        import jax.numpy as jnp
+
+                        idx_mat = put(np.stack([s["idx"] for s in seg]))
+                        bs_vec = put(np.array([s["size"] for s in seg],
+                                              np.int32))
+                        keys = jnp.stack(
+                            [gen.jax_key(self.steps_done + i)
+                             for i in range(len(seg))])
+                        params, velocities, ms = self._train_scan(
+                            params, velocities, self.hypers(), dataset,
+                            targets, idx_mat, bs_vec, put(keys))
+                        losses, n_errs, confs = (np.asarray(m)
+                                                 for m in ms)
+                        stacked = [(losses[i], n_errs[i], confs[i])
+                                   for i in range(len(seg))]
+                    self.steps_done += len(seg)
+                    for s, m in zip(seg, stacked):
+                        feed_decision(s, m)
+                    account(len(seg), sum(s["size"] for s in seg),
+                            _time.perf_counter() - t_iter, True)
+                elif is_train:
+                    # epoch tail: metrics first, Decision rules, and the
+                    # update applies only if gd_skip stayed open
+                    # (unit-path parity)
+                    idx = put(mb["idx"])
+                    bs = np.int32(mb["size"])
+                    key = prng.get("fused_trainer").jax_key(self.steps_done)
+                    metrics = self._eval_step(params, dataset, targets,
+                                              idx, bs, key, True)
+                    feed_decision(mb, metrics)
+                    if not bool(decision.gd_skip):
+                        params, velocities, _ = self._train_step(
+                            params, velocities, self.hypers(), dataset,
+                            targets, idx, bs, key)
+                    self.steps_done += 1
+                    account(1, mb["size"], _time.perf_counter() - t_iter,
+                            True)
+                else:
+                    metrics = self._eval_step(params, dataset, targets,
+                                              put(mb["idx"]),
+                                              np.int32(mb["size"]),
+                                              self._key0, False)
+                    feed_decision(mb, metrics)
+                    account(1, 0, _time.perf_counter() - t_iter, False)
+                if bool(decision.epoch_ended):
+                    epoch_end_hook()
+            self.writeback(params, velocities)
+        finally:
+            loader.indices_only = was_indices_only

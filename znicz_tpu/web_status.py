@@ -53,6 +53,9 @@ class WebStatus:
             info = {"name": wf.name, "stopped": bool(wf.stopped),
                     "units": [{"name": u.name, "runs": u.run_count}
                               for u in wf.units if u.run_count]}
+            fused = getattr(wf, "fused_stats", None)
+            if fused and fused.get("wall_s"):
+                info["fused"] = dict(fused)
             for u in wf.units:
                 if isinstance(u, DecisionBase):
                     info["epoch"] = int(u.epoch_number)
