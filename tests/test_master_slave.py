@@ -2,6 +2,7 @@
 master + slaves, SURVEY.md §4 'Distributed testing')."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -66,3 +67,221 @@ def test_master_slave_trains(tmp_path):
     # training actually converged on the master's aggregated params
     valid = dec.epoch_metrics[1]
     assert valid is not None and valid["err_pct"] < 70.0, valid
+
+def _register(sock, slave_id):
+    """Raw-socket handshake (the Client's own first message)."""
+    import pickle
+
+    from znicz_tpu.network_common import handshake_request
+
+    msg = handshake_request()
+    msg["id"] = slave_id
+    sock.send(pickle.dumps(msg))
+    return pickle.loads(sock.recv())
+
+
+def test_slave_death_requeues_job_and_training_completes(tmp_path):
+    """SURVEY §2.4 elastic membership: a slave that takes a job and dies
+    must not lose the job — the master re-queues it after job_timeout and a
+    slave that joined mid-run finishes the training (VERDICT r2 missing #1)."""
+    import pickle
+
+    import zmq
+
+    from znicz_tpu.client import Client
+    from znicz_tpu.server import Server
+
+    endpoint = "tcp://127.0.0.1:17571"
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, endpoint=endpoint, job_timeout=1.0)
+    server_thread = threading.Thread(target=server.serve, daemon=True)
+    server_thread.start()
+
+    # the doomed slave: registers, takes a job, dies without replying
+    ctx = zmq.Context.instance()
+    doomed = ctx.socket(zmq.REQ)
+    doomed.setsockopt(zmq.RCVTIMEO, 10_000)
+    doomed.setsockopt(zmq.LINGER, 0)
+    doomed.connect(endpoint)
+    assert _register(doomed, "doomed")["ok"]
+    doomed.send(pickle.dumps({"cmd": "job", "id": "doomed"}))
+    rep = pickle.loads(doomed.recv())
+    assert "job" in rep and "params" in rep
+    doomed_jid = rep["job_id"]
+    doomed.close(0)                          # died mid-job
+
+    # a healthy slave joins MID-RUN (after the death) and finishes the job
+    healthy = Client(_make_workflow(tmp_path / "s"), endpoint=endpoint,
+                     slave_id="healthy")
+    healthy.run()
+    server_thread.join(timeout=60)
+    assert not server_thread.is_alive()
+
+    dec = master_wf.decision
+    assert bool(dec.complete)
+    assert server.jobs_requeued >= 1          # the doomed job came back
+    assert doomed_jid not in server._inflight
+    assert server.jobs_by_slave.get("healthy", 0) > 0
+    assert server.jobs_by_slave.get("doomed", 0) == 0
+    valid = dec.epoch_metrics[1]
+    assert valid is not None and valid["err_pct"] < 70.0, valid
+
+
+def test_stale_update_dropped_deterministic(tmp_path):
+    """One job, one accepted update: an update for a job that was already
+    reaped (slow slave past job_timeout) is rejected and does NOT touch the
+    master's weights."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, job_timeout=0.0)   # reap instantly
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields()})["ok"]
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    jid = rep["job_id"]
+    time.sleep(0.01)
+    server._reap_lost_jobs()                      # job re-queued
+    assert server.jobs_requeued == 1
+
+    before = {f.name: {k: np.array(a.map_read()) for k, a in
+                       f.params().items()}
+              for f in master_wf.forwards if f.has_weights}
+    poisoned = {name: {k: np.full_like(v, 1e6) for k, v in layer.items()}
+                for name, layer in before.items()}
+    late = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                           "deltas": poisoned, "metrics": {"loss": 0.0}})
+    assert late == {"ok": False, "stale": True}
+    assert server.stale_updates == 1
+    for f in master_wf.forwards:
+        if f.has_weights:
+            for k, a in f.params().items():
+                np.testing.assert_array_equal(np.array(a.map_read()),
+                                              before[f.name][k])
+
+
+def test_midrun_joiner_receives_current_weights(tmp_path):
+    """A slave registering mid-run gets the master's CURRENT params, not
+    the initial ones."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    # simulate training progress: nudge the master's weights
+    first = next(f for f in master_wf.forwards if f.has_weights)
+    w = first.weights.map_write()
+    w += 0.125
+    current = np.array(first.weights.map_read())
+
+    assert server._handle({"cmd": "register", "id": "late",
+                           **_handshake_fields()})["ok"]
+    rep = server._handle({"cmd": "job", "id": "late"})
+    assert "params" in rep
+    got = np.asarray(rep["params"][first.name]["weights"])
+    np.testing.assert_array_equal(got, current)
+
+
+def _handshake_fields():
+    from znicz_tpu.network_common import handshake_request
+
+    msg = handshake_request()
+    del msg["cmd"]
+    return msg
+
+
+def test_handshake_version_mismatch_refused(tmp_path):
+    from znicz_tpu.network_common import config_digest
+    from znicz_tpu.server import Server
+
+    server = Server(_make_workflow(tmp_path / "m"))
+    rep = server._handle({"cmd": "register", "id": "old", "version": 999,
+                          "config_digest": config_digest()})
+    assert rep["ok"] is False and "version mismatch" in rep["error"]
+    assert "old" not in server.slaves
+    # a compatible peer still registers fine afterwards
+    assert server._handle({"cmd": "register", "id": "new",
+                           **_handshake_fields()})["ok"]
+
+
+def test_handshake_digest_mismatch_refused_client_side(tmp_path):
+    """A slave running a DIFFERENT config raises a clean error instead of
+    training against incompatible weights."""
+    import pickle
+
+    import zmq
+
+    from znicz_tpu.client import Client
+    from znicz_tpu.server import Server
+
+    endpoint = "tcp://127.0.0.1:17572"
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, endpoint=endpoint)
+
+    # master thread: answer exactly one request, then exit
+    def one_reply():
+        import zmq as _zmq
+
+        ctx = _zmq.Context.instance()
+        sock = ctx.socket(_zmq.REP)
+        sock.bind(endpoint)
+        try:
+            req = pickle.loads(sock.recv())
+            sock.send(pickle.dumps(server._handle(req)))
+        finally:
+            sock.close(0)
+
+    t = threading.Thread(target=one_reply, daemon=True)
+    t.start()
+
+    slave_wf = _make_workflow(tmp_path / "s")
+    client = Client(slave_wf, endpoint=endpoint, slave_id="misconfigured")
+    import unittest.mock as mock
+
+    from znicz_tpu import network_common
+
+    # patch the CLIENT's handshake only (config_digest itself is shared by
+    # both peers in this single-process test, so patching it would keep
+    # them in agreement)
+    bad = {"cmd": "register", "version": network_common.PROTOCOL_VERSION,
+           "config_digest": "deadbeefdeadbeef"}
+    with mock.patch.object(network_common, "handshake_request",
+                           return_value=bad):
+        with pytest.raises(RuntimeError, match="digest mismatch"):
+            client.run()
+    t.join(timeout=10)
+
+
+def test_config_digest_ignores_host_local_paths():
+    """Host-local paths (snapshot dirs, data_path) differ per machine and
+    must not fail the handshake; model config changes must."""
+    from znicz_tpu.network_common import config_digest
+
+    base = config_digest()
+    root.common.dirs.snapshots = "/somewhere/else/entirely"
+    root.mnist.loader.data_path = "/mnt/other/mnist.npz"
+    assert config_digest() == base
+    old = root.mnist.loader.minibatch_size
+    try:
+        root.mnist.loader.minibatch_size = int(old) + 1
+        assert config_digest() != base      # model config DOES matter
+    finally:
+        root.mnist.loader.minibatch_size = old
+        root.mnist.loader.data_path = ""
+
+
+def test_unregistered_slave_gets_no_jobs_or_updates(tmp_path):
+    """The handshake is a gate: job/update from a peer that never passed
+    (or failed) register must be refused, not served."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    rep = server._handle({"cmd": "job", "id": "ghost"})
+    assert rep["ok"] is False and "not registered" in rep["error"]
+    rep = server._handle({"cmd": "update", "id": "ghost", "job_id": 1,
+                          "deltas": {}, "metrics": {}})
+    assert rep["ok"] is False and "not registered" in rep["error"]
+    # a refused register does not grant membership either
+    server._handle({"cmd": "register", "id": "old", "version": 0,
+                    "config_digest": "x"})
+    rep = server._handle({"cmd": "job", "id": "old"})
+    assert rep["ok"] is False and "not registered" in rep["error"]

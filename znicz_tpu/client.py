@@ -83,10 +83,15 @@ class Client:
         ``recv_timeout`` — master-death tolerance); returns jobs done."""
         import zmq
 
+        from znicz_tpu.network_common import handshake_request
+
         ctx = zmq.Context.instance()
         sock = self._connect(ctx, int(recv_timeout * 1000))
         try:
-            self._rpc(sock, {"cmd": "register"})
+            rep = self._rpc(sock, handshake_request())
+            if not rep.get("ok"):
+                raise RuntimeError(
+                    f"master refused registration: {rep.get('error')}")
             while True:
                 try:
                     rep = self._rpc(sock, {"cmd": "job"})

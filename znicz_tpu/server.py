@@ -43,7 +43,10 @@ class Server:
         self.loader = workflow.loader
         self.decision = workflow.decision
         self.slaves: Dict[str, float] = {}          # id -> last seen
+        self.registered: set = set()                # handshake-passed ids
         self.jobs_done = 0
+        self.jobs_requeued = 0
+        self.stale_updates = 0
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
@@ -78,6 +81,7 @@ class Server:
         for jid in lost:
             job, _, sid = self._inflight.pop(jid)
             self._pending.append(job)
+            self.jobs_requeued += 1
 
     def _next_job(self) -> Optional[dict]:
         self._reap_lost_jobs()
@@ -150,10 +154,26 @@ class Server:
     def _handle(self, req: dict) -> dict:
         cmd = req.get("cmd")
         sid = req.get("id", "?")
-        self.slaves[sid] = time.time()
+        if sid in self.registered:          # membership stamp gated on
+            self.slaves[sid] = time.time()  # the handshake, like jobs
         if cmd == "register":
-            return {"ok": True,
+            from znicz_tpu.network_common import (PROTOCOL_VERSION,
+                                                  check_handshake)
+
+            refusal = check_handshake(req)
+            if refusal:
+                self.slaves.pop(sid, None)      # refused != member
+                self.registered.discard(sid)
+                return {"ok": False, "error": refusal}
+            self.registered.add(sid)
+            self.slaves[sid] = time.time()
+            return {"ok": True, "version": PROTOCOL_VERSION,
                     "class_lengths": list(self.loader.class_lengths)}
+        if cmd in ("job", "update") and sid not in self.registered:
+            # the handshake is a gate, not advice: a refused (or never
+            # registered) peer gets no params and applies no deltas
+            return {"ok": False, "done": True,
+                    "error": f"slave {sid!r} is not registered"}
         if cmd == "job":
             if bool(self.decision.complete):
                 return {"done": True}
@@ -170,6 +190,10 @@ class Server:
             jid = req.get("job_id")
             entry = self._inflight.pop(jid, None)
             if entry is None:
+                # job already reaped/re-queued (slow slave) or finished —
+                # the update must be DROPPED, not applied (async staleness
+                # bound: one job, one accepted update)
+                self.stale_updates += 1
                 return {"ok": False, "stale": True}
             job, _, _ = entry
             if req.get("deltas"):
