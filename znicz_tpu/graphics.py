@@ -98,12 +98,15 @@ class GraphicsServer:
 
 
 def _is_loopback(endpoint: str) -> bool:
-    """True for ipc:// / inproc:// endpoints and tcp:// on a loopback host."""
+    """True for ipc:// / inproc:// endpoints and tcp:// on a loopback host
+    (host policy shared with RemoteForge via network_common)."""
+    from znicz_tpu.network_common import is_loopback_host
+
     if endpoint.startswith(("ipc://", "inproc://")):
         return True
     if endpoint.startswith("tcp://"):
-        host = endpoint[len("tcp://"):].rsplit(":", 1)[0].strip("[]")
-        return host in ("127.0.0.1", "localhost", "::1", "0.0.0.0")
+        return is_loopback_host(
+            endpoint[len("tcp://"):].rsplit(":", 1)[0].strip("[]"))
     return False
 
 

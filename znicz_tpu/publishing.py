@@ -54,6 +54,10 @@ def gather_report(workflow) -> Dict:
 class MarkdownBackend:
     EXT = ".md"
 
+    def write(self, rep: Dict, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.render(rep))
+
     def render(self, rep: Dict) -> str:
         lines = [f"# Training report — {rep['name']}", "",
                  f"Generated: {rep['time']}", "", "## Metrics", ""]
@@ -70,7 +74,7 @@ class MarkdownBackend:
         return "\n".join(lines) + "\n"
 
 
-class HTMLBackend:
+class HTMLBackend(MarkdownBackend):
     EXT = ".html"
 
     def render(self, rep: Dict) -> str:
@@ -81,7 +85,64 @@ class HTMLBackend:
                 f"</head><body>{body}</body></html>\n")
 
 
-BACKENDS = {"markdown": MarkdownBackend, "html": HTMLBackend}
+class PDFBackend:
+    """PDF report via matplotlib's PdfPages (VERDICT r2 item 9): a title +
+    metrics page, a unit-timing table page, then one page per rendered plot
+    PNG.  The reference's Confluence backend is an explicit drop — it needs
+    a Confluence server, which cannot exist here."""
+
+    EXT = ".pdf"
+
+    def write(self, rep: Dict, path: str) -> None:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        from matplotlib.backends.backend_pdf import PdfPages
+
+        with PdfPages(path) as pdf:
+            fig = plt.figure(figsize=(8.27, 11.69))        # A4 portrait
+            fig.text(0.5, 0.92, f"Training report — {rep['name']}",
+                     ha="center", size=18, weight="bold")
+            fig.text(0.5, 0.88, f"Generated: {rep['time']}", ha="center",
+                     size=10, color="gray")
+            lines = []
+            for key, val in rep["metrics"].items():
+                lines.append(f"{key}: "
+                             f"{json.dumps(val) if isinstance(val, dict) else val}")
+            fig.text(0.1, 0.82, "\n".join(lines), va="top", size=11,
+                     family="monospace")
+            pdf.savefig(fig)
+            plt.close(fig)
+
+            if rep["units"]:
+                fig, ax = plt.subplots(figsize=(8.27, 11.69))
+                ax.axis("off")
+                ax.set_title("Unit timing")
+                cells = [[u["name"], u["runs"], u["time_s"], u["pct"]]
+                         for u in rep["units"]]
+                table = ax.table(
+                    cellText=cells,
+                    colLabels=["unit", "runs", "time (s)", "%"],
+                    loc="upper center")
+                table.auto_set_font_size(False)
+                table.set_fontsize(9)
+                pdf.savefig(fig)
+                plt.close(fig)
+
+            plots_dir = root.common.dirs.get("plots")
+            for png in rep.get("plots", []):
+                img = plt.imread(os.path.join(plots_dir, png))
+                fig, ax = plt.subplots(figsize=(8.27, 11.69))
+                ax.imshow(img)
+                ax.axis("off")
+                ax.set_title(png)
+                pdf.savefig(fig)
+                plt.close(fig)
+
+
+BACKENDS = {"markdown": MarkdownBackend, "html": HTMLBackend,
+            "pdf": PDFBackend}
 
 
 def publish(workflow, backend: str = "markdown",
@@ -91,6 +152,5 @@ def publish(workflow, backend: str = "markdown",
     directory = directory or root.common.dirs.get("reports", "reports")
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"{workflow.name}_report{be.EXT}")
-    with open(path, "w") as f:
-        f.write(be.render(rep))
+    be.write(rep, path)
     return path
