@@ -26,8 +26,11 @@ def test_cifar_trains(small_cifar):
     assert bool(dec.complete)
     valid = dec.epoch_metrics[1]
     assert valid is not None
-    # 10-class chance = 90% err; textures are easy for convs
-    assert valid["err_pct"] < 55.0, valid
+    # 10-class chance = 90% err.  The r3 difficulty tier (datasets.py:
+    # one cue per class, overlapping jitter, distractor grating) leaves
+    # the full anchor config at ~41% err and this shrunk config at ~67%
+    # — assert "beats chance clearly" with margin for platform variance.
+    assert valid["err_pct"] < 78.0, valid
 
 
 def test_cifar_graph_shapes(small_cifar):

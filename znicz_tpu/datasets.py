@@ -71,11 +71,19 @@ def digits(n: int, *, size: int = 28, noise: float = 0.15, jitter: int = 2,
     return data, labels
 
 
-def tinyimages(n: int, *, size: int = 32, noise: float = 0.2,
+def tinyimages(n: int, *, size: int = 32, noise: float = 0.25,
                stream: str = "dataset.tiny") -> Tuple[np.ndarray, np.ndarray]:
     """n samples of (size, size, 3) float32 in [0,1] + int32 labels.
     Classes are parametric textures: oriented sinusoid gratings (0-4) and
-    gaussian blobs in distinct color channels / positions (5-9)."""
+    gaussian blobs at class-coded positions (5-9).
+
+    Difficulty tier r3 (VERDICT r2 weak #2 — the old tier triple-coded
+    every class in angle+frequency+color / position+channel+width, so the
+    CIFAR conv net hit 0.0% valid err and regressions were invisible):
+    each class now carries exactly ONE reliable cue (grating angle, blob
+    position) with overlapping jitter; color, frequency, channel and blob
+    width are random nuisances; every image also gets a faint random
+    distractor grating plus heavier pixel noise."""
     gen = prng.get(stream)
     rng = gen.state
     labels = rng.integers(0, 10, size=n).astype(np.int32)
@@ -86,22 +94,33 @@ def tinyimages(n: int, *, size: int = 32, noise: float = 0.2,
         img = np.zeros((size, size, 3), np.float32)
         phase = float(rng.uniform(0, 2 * np.pi))
         if k < 5:
-            angle = k * np.pi / 5 + float(rng.normal(0, 0.08))
-            freq = 3.0 + k
+            # the only reliable cue: orientation (36deg apart, 7deg jitter)
+            angle = k * np.pi / 5 + float(rng.normal(0, 0.10))
+            freq = float(rng.uniform(3.0, 6.0))          # nuisance
             wave = 0.5 + 0.5 * np.sin(
                 2 * np.pi * freq * (xx * np.cos(angle) + yy * np.sin(angle))
                 + phase)
-            color = np.array([0.9, 0.5 + 0.1 * k, 0.3], np.float32)
+            color = rng.uniform(0.5, 1.0, 3).astype(np.float32)  # nuisance
             img = wave[..., None] * color
         else:
-            cx = 0.2 + 0.15 * (k - 5) + float(rng.normal(0, 0.03))
-            cy = 0.3 + 0.1 * (k - 5) + float(rng.normal(0, 0.03))
-            sigma = 0.08 + 0.02 * (k - 5)
+            # the only reliable cue: blob position (with overlap jitter)
+            cx = 0.25 + 0.125 * (k - 5) + float(rng.normal(0, 0.04))
+            cy = 0.35 + 0.08 * (k - 5) + float(rng.normal(0, 0.04))
+            sigma = float(rng.uniform(0.08, 0.16))       # nuisance
             blob = np.exp(-(np.square(xx - cx) + np.square(yy - cy))
                           / (2 * sigma ** 2))
-            chan = (k - 5) % 3
+            chan = int(rng.integers(0, 3))               # nuisance
             img[..., chan] = blob
             img[..., (chan + 1) % 3] = 0.3 * blob
+        # faint distractor grating over every image (both class families)
+        dang = float(rng.uniform(0, np.pi))
+        dfreq = float(rng.uniform(3.0, 6.0))
+        dphase = float(rng.uniform(0, 2 * np.pi))
+        dist = 0.5 + 0.5 * np.sin(
+            2 * np.pi * dfreq * (xx * np.cos(dang) + yy * np.sin(dang))
+            + dphase)
+        img += 0.10 * dist[..., None] * \
+            rng.uniform(0.3, 1.0, 3).astype(np.float32)
         img += rng.normal(0.0, noise, size=img.shape).astype(np.float32)
         data[i] = np.clip(img, 0.0, 1.0)
     return data, labels
