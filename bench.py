@@ -178,11 +178,10 @@ def main(legacy: bool = False) -> None:
             bs = [bs[0]] * n
         return np.stack(idx), np.asarray(bs, np.int32)
 
-    def keys_for(start, n):
-        import jax.numpy as jnp
+    base_key = prng.get("bench").jax_base_key()
 
-        gen = prng.get("bench")
-        return jnp.stack([gen.jax_key(start + i) for i in range(n)])
+    def steps_from(start):
+        return np.arange(start, start + STEPS, dtype=np.int32)
 
     @jax.jit
     def _probe(params, losses):
@@ -209,20 +208,20 @@ def main(legacy: bool = False) -> None:
     # warmup at the SAME scan length so the timed call reuses the compile
     idx_mat, bs_vec = draw_minibatches(STEPS)
     params, vels, ms = scan(params, vels, hypers, dataset, targets,
-                            idx_mat[:, :], bs_vec, keys_for(0, STEPS))
+                            idx_mat[:, :], bs_vec, base_key, steps_from(0))
     materialize(params, ms[0])
     warmup_losses = [float(l) for l in np.asarray(ms[0])]
     # XLA's cost model counts the scan (while-loop) body ONCE, so the
     # lowered scan's flops ARE the per-step flops
     xla_flops_step = xla_flops(
         scan, params, vels, hypers, dataset, targets, idx_mat, bs_vec,
-        keys_for(0, STEPS))
+        base_key, steps_from(0))
 
     idx_mat, bs_vec = draw_minibatches(STEPS)
-    keys = keys_for(STEPS, STEPS)
+    steps = steps_from(STEPS)
     t0 = time.perf_counter()
     params, vels, ms = scan(params, vels, hypers, dataset, targets,
-                            idx_mat, bs_vec, keys)
+                            idx_mat, bs_vec, base_key, steps)
     materialize(params, ms[0])
     elapsed = time.perf_counter() - t0
 
@@ -242,7 +241,8 @@ def main(legacy: bool = False) -> None:
     try:
         with jax.profiler.trace(PROFILE_DIR):
             params, vels, ms = scan(params, vels, hypers, dataset, targets,
-                                    idx_mat, bs_vec, keys_for(3000, STEPS))
+                                    idx_mat, bs_vec, base_key,
+                                    steps_from(3000))
             materialize(params, ms[0])
         print(f"profiler trace -> {PROFILE_DIR}/", file=sys.stderr)
     except Exception as exc:                      # platform can't trace

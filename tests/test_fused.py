@@ -216,3 +216,25 @@ def test_fused_stats_observability(tmp_path):
         assert info["fused"]["train_steps"] == s["train_steps"]
     finally:
         status.stop()
+
+
+def test_fused_remat_matches(tmp_path):
+    """jax.checkpoint rematerialization changes memory, not math: loss
+    curves and final weights match the non-remat fused run."""
+    root.common.dirs.snapshots = str(tmp_path)
+    lf, wf_ = run_fused(fresh_mnist())
+
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    wf2 = fresh_mnist()
+    losses2 = []
+    wf2.decision.on_epoch_end.append(
+        lambda d: losses2.append(d.epoch_metrics[2]["loss"]))
+    trainer = FusedTrainer(wf2, remat=True)
+    assert trainer.remat is True
+    trainer.run()
+    np.testing.assert_allclose(lf, losses2, rtol=1e-5)
+    for f in wf2.forwards:
+        np.testing.assert_allclose(np.array(f.weights.map_read()),
+                                   wf_[f.name], rtol=1e-4, atol=1e-6,
+                                   err_msg=f.name)

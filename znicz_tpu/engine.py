@@ -1,0 +1,34 @@
+"""Engine selection for a built workflow: the unit-at-a-time graph engine
+(reference execution semantics, ``Workflow.run``) vs the fused SPMD fast
+path (``znicz_tpu/parallel/fused.py``), chosen by
+``root.common.engine.fused`` — the launcher's ``--fused`` flag.
+
+The fused path requires the StandardWorkflow graph shape (forwards / gds /
+loader / decision) and no tied weights; anything else (Kohonen, RBM,
+hand-wired graphs) falls back to the unit engine automatically.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.core.config import root
+
+
+def wants_fused() -> bool:
+    return bool(root.common.engine.get("fused", False))
+
+
+def train(workflow) -> None:
+    """Train ``workflow`` with the configured engine."""
+    if wants_fused() and all(
+            getattr(workflow, a, None) is not None
+            for a in ("forwards", "gds", "loader", "decision")):
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        try:
+            trainer = FusedTrainer(workflow)
+        except ValueError:          # e.g. tied weights -> unit path
+            workflow.run()
+            return
+        trainer.run()
+    else:
+        workflow.run()

@@ -62,6 +62,10 @@ class Launcher:
         parser.add_argument("--profile", default="",
                             help="capture a jax.profiler trace of the whole "
                                  "run into this directory")
+        parser.add_argument("--fused", action="store_true",
+                            help="train with the fused SPMD fast path "
+                                 "(one jitted scan step) instead of the "
+                                 "unit-at-a-time engine")
         parser.add_argument("--fitness", action="store_true",
                             help="print a final JSON line with the run's "
                                  "fitness (genetics subprocess evaluation)")
@@ -82,6 +86,8 @@ class Launcher:
             args.config = None
         if args.backend:
             root.common.engine.backend = args.backend
+        if args.fused:
+            root.common.engine.fused = True
         if args.seed is not None:
             from znicz_tpu.core import prng
 

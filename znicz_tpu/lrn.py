@@ -29,6 +29,12 @@ class LRNormalizerForward(ForwardBase):
     def apply(self, params, x):
         import jax.numpy as jnp
 
+        from znicz_tpu.core.config import root
+
+        if bool(root.common.engine.get("pallas_lrn", False)):
+            from znicz_tpu.ops.lrn_pallas import lrn
+
+            return lrn(x, self.n, self.alpha, self.beta, self.k)
         half = self.n // 2
         sq = jnp.square(x)
         # sum over a window of n adjacent channels (zero-padded at the ends)

@@ -42,12 +42,15 @@ class FusedTrainer:
     """Compile and drive fused steps for a built+initialized workflow with
     ``forwards``, ``gds``, ``loader``, ``evaluator``, ``decision``."""
 
-    def __init__(self, workflow, mesh=None):
+    def __init__(self, workflow, mesh=None, remat=None):
         from znicz_tpu.all2all import All2AllSoftmax
         from znicz_tpu.dropout import DropoutForward
         from znicz_tpu.evaluator import EvaluatorSoftmax
         from znicz_tpu.pooling import StochasticPoolingBase
 
+        if remat is None:
+            remat = bool(root.common.engine.get("remat", False))
+        self.remat = remat
         self.workflow = workflow
         self.forwards = list(workflow.forwards)
         self.loader = workflow.loader
@@ -210,6 +213,12 @@ class FusedTrainer:
     #: the mesh has a ``model`` axis (AlexNet's 4096-wide fc6/fc7)
     tp_threshold = 1024
 
+    #: rematerialize the forward during backward (``jax.checkpoint``) —
+    #: trades ~1/3 more FLOPs for not keeping activations live, the
+    #: standard HBM lever for big batches/models
+    #: (root.common.engine.remat or FusedTrainer(..., remat=True))
+    remat = False
+
     def param_sharding(self, name, k, arr):
         """Per-param placement: wide (out, in) FC weights shard their output
         rows over the ``model`` axis (and the matching bias over ``model``);
@@ -250,6 +259,11 @@ class FusedTrainer:
             return self.loss_and_metrics(p, data, tgt, batch_size, key,
                                          train=True)
 
+        if self.remat:
+            # recompute the forward during the backward instead of keeping
+            # activations live (SURVEY hot-path note: remat is the HBM
+            # lever; ~1/3 extra FLOPs)
+            lf = jax.checkpoint(lf)
         grads, metrics = jax.grad(lf, has_aux=True)(params)
         new_p, new_v = {}, {}
         for name, layer_p in params.items():
@@ -275,25 +289,28 @@ class FusedTrainer:
 
     def make_train_scan(self):
         """K steps in ONE dispatch via ``lax.scan`` over stacked
-        (idx, batch_size, key) rows — K is static per (K,) shape.  Each
-        scanned step is the same ``_step_core`` with the same per-step keys
-        the sequential path would draw, so semantics are identical; what
+        (idx, batch_size, step_number) rows — K is static per (K,) shape.
+        Each scanned step is the same ``_step_core`` with the same per-step
+        key the sequential path would draw (``fold_in(base, step)`` runs
+        IN-GRAPH — eager per-step key construction costs several dispatches
+        each, ~3ms/key on tunneled links), so semantics are identical; what
         changes is dispatch count, which dominates wall time on
         high-latency links (tunneled TPU: ~20ms/dispatch vs ~5ms compute —
         bench r3).  Metrics come back stacked, one per step."""
         import jax
 
         def chunk(params, velocities, hypers, dataset, targets, idx_mat,
-                  bs_vec, keys):
+                  bs_vec, base_key, step_nums):
             def body(carry, xs):
                 p, v = carry
-                idx, bs, key = xs
+                idx, bs, step = xs
+                key = jax.random.fold_in(base_key, step)
                 p, v, metrics = self._step_core(
                     p, v, hypers, dataset, targets, idx, bs, key)
                 return (p, v), metrics
 
             (p, v), ms = jax.lax.scan(
-                body, (params, velocities), (idx_mat, bs_vec, keys))
+                body, (params, velocities), (idx_mat, bs_vec, step_nums))
             return p, v, ms
 
         return jax.jit(chunk, donate_argnums=(0, 1))
@@ -459,17 +476,16 @@ class FusedTrainer:
                             np.int32(seg[0]["size"]), key)
                         stacked = [metrics]
                     else:
-                        import jax.numpy as jnp
-
                         idx_mat = put(np.stack([s["idx"] for s in seg]))
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
-                        keys = jnp.stack(
-                            [gen.jax_key(self.steps_done + i)
-                             for i in range(len(seg))])
+                        steps = np.arange(self.steps_done,
+                                          self.steps_done + len(seg),
+                                          dtype=np.int32)
                         params, velocities, ms = self._train_scan(
                             params, velocities, self.hypers(), dataset,
-                            targets, idx_mat, bs_vec, put(keys))
+                            targets, idx_mat, bs_vec,
+                            put(gen.jax_base_key()), put(steps))
                         losses, n_errs, confs = (np.asarray(m)
                                                  for m in ms)
                         stacked = [(losses[i], n_errs[i], confs[i])

@@ -89,7 +89,8 @@ class KohonenWorkflow(Workflow):
 def run(device=None) -> KohonenWorkflow:
     wf = KohonenWorkflow()
     wf.initialize(device=device)
-    wf.run()
+    from znicz_tpu.engine import train
+    train(wf)
     wf.print_stats()
     return wf
 

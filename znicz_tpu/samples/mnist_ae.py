@@ -150,7 +150,8 @@ def run(snapshot: str = "", device=None) -> MnistAEWorkflow:
     if snapshot:
         from znicz_tpu import snapshotter as snap_mod
         snap_mod.restore(wf, Snapshotter.load(snapshot))
-    wf.run()
+    from znicz_tpu.engine import train
+    train(wf)
     wf.print_stats()
     return wf
 

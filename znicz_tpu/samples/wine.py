@@ -76,7 +76,8 @@ def run(snapshot: str = "", device=None) -> WineWorkflow:
         from znicz_tpu import snapshotter as snap_mod
         from znicz_tpu.snapshotter import Snapshotter
         snap_mod.restore(wf, Snapshotter.load(snapshot))
-    wf.run()
+    from znicz_tpu.engine import train
+    train(wf)
     wf.print_stats()
     return wf
 
