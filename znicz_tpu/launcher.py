@@ -27,7 +27,8 @@ from typing import List, Optional
 from znicz_tpu.core.config import apply_overrides, root
 from znicz_tpu.core.logger import setup_logging
 
-SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet", "wine")
+SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet", "wine",
+           "yale_faces")
 
 
 def _load_module(spec: str, tag: str):

@@ -76,6 +76,7 @@ class FusedTrainer:
         self._train_step = None
         self._train_scan = None
         self._eval_step = None
+        self._eval_scan = None
         self._key0 = prng.get("fused_trainer").jax_key(0)
         self.steps_done = 0
         #: per-step timing accumulated by run() (SURVEY.md §5 Tracing —
@@ -315,6 +316,27 @@ class FusedTrainer:
 
         return jax.jit(chunk, donate_argnums=(0, 1))
 
+    def make_eval_scan(self):
+        """Metrics for K eval minibatches (TEST/VALID) in one dispatch —
+        params don't change between eval steps, so the scan is a pure map;
+        metrics come back stacked and are fed to the Decision in order."""
+        import jax
+
+        @jax.jit
+        def chunk(params, dataset, targets, idx_mat, bs_vec):
+            def body(carry, xs):
+                idx, bs = xs
+                data = jax.numpy.take(dataset, idx, axis=0)
+                tgt = jax.numpy.take(targets, idx, axis=0)
+                _, metrics = self.loss_and_metrics(
+                    params, data, tgt, bs, self._key0, train=False)
+                return carry, metrics
+
+            _, ms = jax.lax.scan(body, 0, (idx_mat, bs_vec))
+            return ms
+
+        return chunk
+
     def make_eval_step(self):
         """Metrics-only step.  ``train`` is static: True replays the exact
         train-mode forward (dropout/stochastic masks from the same key) —
@@ -375,6 +397,7 @@ class FusedTrainer:
             self._eval_step = self.make_eval_step()
         if self._train_scan is None and self.scan_chunk > 1:
             self._train_scan = self.make_train_scan()
+            self._eval_scan = self.make_eval_scan()
         params = self.extract_params()
         velocities = self.extract_velocities()
         dataset = loader.original_data.devmem
@@ -513,12 +536,35 @@ class FusedTrainer:
                     account(1, mb["size"], _time.perf_counter() - t_iter,
                             True)
                 else:
-                    metrics = self._eval_step(params, dataset, targets,
-                                              put(mb["idx"]),
-                                              np.int32(mb["size"]),
-                                              self._key0, False)
-                    feed_decision(mb, metrics)
-                    account(1, 0, _time.perf_counter() - t_iter, False)
+                    # TEST/VALID: params are frozen, so consecutive eval
+                    # minibatches scan as a pure map in one dispatch
+                    seg = [mb]
+                    max_seg = self.scan_chunk if self._eval_scan else 1
+                    while len(seg) < max_seg:
+                        nxt = self._advance()
+                        if nxt["class"] != TRAIN:
+                            seg.append(nxt)
+                        else:
+                            pending = nxt
+                            break
+                    if len(seg) == 1:
+                        stacked = [self._eval_step(
+                            params, dataset, targets, put(mb["idx"]),
+                            np.int32(mb["size"]), self._key0, False)]
+                    else:
+                        idx_mat = put(np.stack([s["idx"] for s in seg]))
+                        bs_vec = put(np.array([s["size"] for s in seg],
+                                              np.int32))
+                        ms = self._eval_scan(params, dataset, targets,
+                                             idx_mat, bs_vec)
+                        losses, n_errs, confs = (np.asarray(m)
+                                                 for m in ms)
+                        stacked = [(losses[i], n_errs[i], confs[i])
+                                   for i in range(len(seg))]
+                    for s, m in zip(seg, stacked):
+                        feed_decision(s, m)
+                    account(len(seg), 0, _time.perf_counter() - t_iter,
+                            False)
                 if bool(decision.epoch_ended):
                     epoch_end_hook()
             self.writeback(params, velocities)

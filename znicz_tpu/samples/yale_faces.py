@@ -1,0 +1,175 @@
+"""YaleFaces-style sample (SURVEY §1 L10 lists YaleFaces among the
+reference's ``znicz/samples/``): grayscale face identification from
+DIRECTORIES of image files via ``FullBatchFileImageLoader`` — this sample
+exercises the real file pipeline (directory scan, PIL decode, resize,
+native u8->f32) end to end, unlike the resident-array samples.
+
+No face data exists in this environment, so ``ensure_dataset`` synthesizes
+a deterministic stand-in with the Yale B structure: each subject is a
+fixed set of facial-geometry parameters (face ellipse, eye spacing, brow,
+mouth curvature); each image varies ONLY nuisance conditions — lighting
+direction (the defining Yale variation), exposure, small pose shifts and
+noise — so identity is the sole reliable cue.  Images are written as real
+PNG files under ``<data_dir>/<train|valid>/<subject_NN>/``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.image import FullBatchFileImageLoader
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+root.yale_faces.defaults({
+    "loader": {"data_dir": "yale_faces_data", "n_subjects": 8,
+               "n_train_per_subject": 16, "n_valid_per_subject": 4,
+               "minibatch_size": 32, "size": 32},
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0001,
+    "decision": {"max_epochs": 10, "fail_iterations": 0},
+    "snapshotter": {"prefix": "yale", "interval": 0},
+})
+
+
+def _render_face(rng, geom, size):
+    """One (size, size) image of the subject ``geom`` under a random
+    lighting direction/exposure — Yale's nuisance axes."""
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    dy = float(rng.uniform(-0.04, 0.04))
+    dx = float(rng.uniform(-0.04, 0.04))
+    cy, cx = 0.5 + dy, 0.5 + dx
+    face = np.exp(-(((xx - cx) / geom["fw"]) ** 2
+                    + ((yy - cy) / geom["fh"]) ** 2) ** 2)
+    img = 0.55 * face
+    for side in (-1.0, 1.0):
+        ex = cx + side * geom["eye_dx"]
+        ey = cy - geom["eye_dy"]
+        eye = np.exp(-((xx - ex) ** 2 + (yy - ey) ** 2)
+                     / (2 * geom["eye_r"] ** 2))
+        img -= 0.5 * eye
+        brow = np.exp(-((xx - ex) ** 2 / (2 * (2.2 * geom["eye_r"]) ** 2)
+                        + (yy - (ey - geom["brow_h"])) ** 2
+                        / (2 * (0.35 * geom["eye_r"]) ** 2)))
+        img -= 0.3 * brow
+    mouth_y = cy + geom["mouth_dy"] + geom["mouth_curve"] * \
+        np.square((xx - cx) / geom["fw"])
+    mouth = np.exp(-((yy - mouth_y) ** 2 / (2 * 0.015 ** 2))
+                   - ((xx - cx) ** 2 / (2 * geom["mouth_w"] ** 2)))
+    img -= 0.4 * mouth
+    # nuisance: directional lighting + exposure + noise
+    ang = float(rng.uniform(0, 2 * np.pi))
+    light = 0.5 + 0.5 * ((xx - 0.5) * np.cos(ang) + (yy - 0.5) * np.sin(ang))
+    img = img * (0.45 + 0.55 * light) * float(rng.uniform(0.7, 1.0))
+    img += rng.normal(0, 0.04, img.shape).astype(np.float32)
+    return np.clip(img, 0.0, 1.0)
+
+
+def _subject_geometry(rng):
+    return {
+        "fw": float(rng.uniform(0.26, 0.36)),
+        "fh": float(rng.uniform(0.33, 0.45)),
+        "eye_dx": float(rng.uniform(0.09, 0.15)),
+        "eye_dy": float(rng.uniform(0.06, 0.12)),
+        "eye_r": float(rng.uniform(0.02, 0.035)),
+        "brow_h": float(rng.uniform(0.04, 0.07)),
+        "mouth_dy": float(rng.uniform(0.12, 0.2)),
+        "mouth_w": float(rng.uniform(0.05, 0.1)),
+        "mouth_curve": float(rng.uniform(-0.12, 0.12)),
+    }
+
+
+def ensure_dataset(data_dir=None) -> str:
+    """Write the PNG directory tree if absent; returns the base dir."""
+    from PIL import Image
+
+    cfg = root.yale_faces.loader
+    base = data_dir or cfg.get("data_dir")
+    if os.path.isdir(os.path.join(base, "train")):
+        return base
+    size = int(cfg.get("size"))
+    gen = prng.get("dataset.yale")
+    rng = gen.state
+    for si in range(int(cfg.get("n_subjects"))):
+        geom = _subject_geometry(rng)
+        for split, count in (("train", int(cfg.get("n_train_per_subject"))),
+                             ("valid", int(cfg.get("n_valid_per_subject")))):
+            d = os.path.join(base, split, f"subject_{si:02d}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(count):
+                img = _render_face(rng, geom, size)
+                Image.fromarray(
+                    (img * 255).astype(np.uint8), mode="L").save(
+                    os.path.join(d, f"img_{i:03d}.png"))
+    return base
+
+
+def make_layers(n_classes):
+    cfg = root.yale_faces
+    gd = {"learning_rate": float(cfg.get("learning_rate")),
+          "gradient_moment": float(cfg.get("gradient_moment")),
+          "weights_decay": float(cfg.get("weights_decay"))}
+    return [
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 8, "kx": 5, "ky": 5, "padding": (2, 2, 2, 2)},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 16, "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 48},
+         "<-": dict(gd)},
+        {"type": "softmax", "->": {"output_sample_shape": n_classes},
+         "<-": dict(gd)},
+    ]
+
+
+class YaleFacesWorkflow(StandardWorkflow):
+    def __init__(self, data_dir=None, **kwargs):
+        cfg = root.yale_faces
+        base = ensure_dataset(data_dir)
+        size = int(cfg.loader.get("size"))
+        # PNGs on disk are grayscale; decode to 3-channel so the conv
+        # stack sees (B, H, W, C) — the reference's image pipeline did the
+        # same channel replication for L-mode inputs
+        loader = FullBatchFileImageLoader(
+            name="loader",
+            train_path=os.path.join(base, "train"),
+            valid_path=os.path.join(base, "valid"),
+            target_shape=(size, size), grayscale=False,
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        super().__init__(
+            name="YaleFacesWorkflow", loader=loader,
+            layers=make_layers(int(cfg.loader.get("n_subjects"))),
+            loss_function="softmax",
+            decision_config={
+                "max_epochs": int(cfg.decision.get("max_epochs")),
+                "fail_iterations": int(cfg.decision.get("fail_iterations"))},
+            snapshotter_config={
+                "prefix": cfg.snapshotter.get("prefix"),
+                "interval": int(cfg.snapshotter.get("interval", 0))},
+            **kwargs)
+
+
+def run(snapshot: str = "", device=None) -> YaleFacesWorkflow:
+    wf = YaleFacesWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        from znicz_tpu.snapshotter import Snapshotter
+
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    from znicz_tpu.engine import train
+
+    train(wf)
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()
