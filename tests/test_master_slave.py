@@ -285,3 +285,34 @@ def test_unregistered_slave_gets_no_jobs_or_updates(tmp_path):
                     "config_digest": "x"})
     rep = server._handle({"cmd": "job", "id": "old"})
     assert rep["ok"] is False and "not registered" in rep["error"]
+
+
+def test_web_status_shows_master_topology(tmp_path):
+    """The dashboard exposes the master/slave topology like the
+    reference's web status did (SURVEY §2.1 Web status)."""
+    import json
+    import urllib.request
+
+    from znicz_tpu.server import Server
+    from znicz_tpu.web_status import WebStatus
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields()})["ok"]
+    server._handle({"cmd": "job", "id": "s1"})
+
+    status = WebStatus(port=0).start()
+    try:
+        status.register(master_wf)
+        status.register_server(server)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            snap = json.load(r)
+        master = snap["master"]
+        assert master["endpoint"] == server.endpoint
+        assert [s["id"] for s in master["slaves"]] == ["s1"]
+        assert master["slaves"][0]["last_seen_s"] >= 0
+        assert snap["workflows"][0]["name"] == master_wf.name
+    finally:
+        status.stop()

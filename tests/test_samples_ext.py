@@ -1,0 +1,72 @@
+"""Extended L10 sample family: Kanji (many-class) and VideoAE (frame
+autoencoder) — SURVEY §1 L10 sample list."""
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+
+
+def test_kanji_trains_many_class(tmp_path):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import kanji
+
+    prng.reset(1013)
+    root.kanji.loader.n_train = 1024
+    root.kanji.loader.n_valid = 256
+    root.kanji.loader.n_classes = 32
+    root.kanji.loader.minibatch_size = 128
+    root.kanji.decision.max_epochs = 6
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = kanji.run()
+    dec = wf.decision
+    assert bool(dec.complete)
+    valid = dec.epoch_metrics[1]
+    # 32 classes -> chance err ~96.9%; strokes are learnable
+    assert valid is not None and valid["err_pct"] < 40.0, valid
+    assert wf.forwards[-1].output.shape[-1] == 32
+
+
+def test_video_ae_learns_frame_manifold(tmp_path):
+    from znicz_tpu.core import prng
+    from znicz_tpu.loader.base import TRAIN, VALID
+    from znicz_tpu.samples import video_ae
+
+    prng.reset(1013)
+    root.video_ae.loader.n_train = 800
+    root.video_ae.loader.n_valid = 200
+    root.video_ae.loader.minibatch_size = 100
+    root.video_ae.decision.max_epochs = 20
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = video_ae.run()
+    dec = wf.decision
+    assert bool(dec.complete)
+    final = dec.epoch_metrics[TRAIN]["loss"]
+    # the AE reconstructs far better than predicting the mean frame:
+    # compare against the variance-based MSE of the training frames
+    data = np.asarray(wf.loader.original_data.mem)
+    per_sample = data.reshape(len(data), -1)
+    base = 0.5 * float(
+        np.mean(np.sum(np.square(per_sample - per_sample.mean(0)), axis=1)))
+    assert final < 0.5 * base, (final, base)
+    assert dec.epoch_metrics[VALID]["loss"] < base
+
+
+def test_samples_fused_engine_smoke(tmp_path):
+    """The new samples also run under the fused fast path (--fused)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import kanji
+
+    prng.reset(1013)
+    root.kanji.loader.n_train = 256
+    root.kanji.loader.n_valid = 128
+    root.kanji.loader.n_classes = 16
+    root.kanji.loader.minibatch_size = 128
+    root.kanji.decision.max_epochs = 2
+    root.common.dirs.snapshots = str(tmp_path)
+    root.common.engine.fused = True
+    try:
+        wf = kanji.run()
+    finally:
+        root.common.engine.fused = False
+    assert bool(wf.decision.complete)
+    assert wf.fused_stats["train_steps"] > 0

@@ -126,6 +126,96 @@ def tinyimages(n: int, *, size: int = 32, noise: float = 0.25,
     return data, labels
 
 
+def kanji(n: int, *, n_classes: int = 64, size: int = 24,
+          noise: float = 0.1, jitter: int = 1,
+          stream: str = "dataset.kanji") -> Tuple[np.ndarray, np.ndarray]:
+    """n samples of (size, size) float32 + int32 labels over ``n_classes``
+    glyph classes — the many-class regime of the reference's Kanji sample.
+    Each class is a fixed random composition of stroke segments on a 6x6
+    grid (derived deterministically from the class index + global seed);
+    samples vary by sub-pixel shift, thickness and noise."""
+    gen = prng.get(stream)
+    rng = gen.state
+    # class structure from a dedicated stream so it is stable regardless
+    # of how many samples have been drawn
+    cls_rng = prng.get(stream + ".classes").state
+    grid = 6
+    strokes = []
+    for c in range(n_classes):
+        segs = []
+        for _ in range(int(cls_rng.integers(4, 8))):
+            r0 = int(cls_rng.integers(0, grid))
+            c0 = int(cls_rng.integers(0, grid))
+            horiz = bool(cls_rng.integers(0, 2))
+            length = int(cls_rng.integers(2, grid))
+            segs.append((r0, c0, horiz, length))
+        strokes.append(segs)
+
+    scale = size // grid
+    labels = rng.integers(0, n_classes, size=n).astype(np.int32)
+    data = np.zeros((n, size, size), np.float32)
+    for i in range(n):
+        g = np.zeros((grid * scale, grid * scale), np.float32)
+        thick = int(rng.integers(1, 3))
+        for r0, c0, horiz, length in strokes[int(labels[i])]:
+            if horiz:
+                r, cs = r0 * scale + scale // 2, slice(
+                    c0 * scale, min((c0 + length) * scale, grid * scale))
+                g[r:r + thick, cs] = 1.0
+            else:
+                rs = slice(r0 * scale,
+                           min((r0 + length) * scale, grid * scale))
+                c = c0 * scale + scale // 2
+                g[rs, c:c + thick] = 1.0
+        dy = int(rng.integers(-jitter, jitter + 1))
+        dx = int(rng.integers(-jitter, jitter + 1))
+        img = np.zeros((size, size), np.float32)
+        src = g[:size, :size]
+        img[max(dy, 0):size + min(dy, 0), max(dx, 0):size + min(dx, 0)] = \
+            src[max(-dy, 0):size + min(-dy, 0),
+                max(-dx, 0):size + min(-dx, 0)]
+        img *= float(rng.uniform(0.7, 1.0))
+        img += rng.normal(0.0, noise, img.shape).astype(np.float32)
+        data[i] = np.clip(img, 0.0, 1.0)
+    return data, labels
+
+
+def videoframes(n: int, *, size: int = 16, noise: float = 0.05,
+                frames_per_clip: int = 8,
+                stream: str = "dataset.video") -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+    """n frames sampled from synthetic clips (the reference's VideoAE
+    regime: an autoencoder trained on video frames).  Each clip is a blob
+    moving on a linear trajectory with fixed shape/brightness; frames
+    within a clip share those statics, so the frame manifold is
+    low-dimensional and learnable by a small AE.  Returns (frames,
+    clip_ids)."""
+    gen = prng.get(stream)
+    rng = gen.state
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    data = np.zeros((n, size, size), np.float32)
+    clip_ids = np.zeros(n, np.int32)
+    i = 0
+    clip = 0
+    while i < n:
+        x0, y0 = rng.uniform(0.2, 0.8, 2)
+        vx, vy = rng.uniform(-0.08, 0.08, 2)
+        sigma = float(rng.uniform(0.08, 0.15))
+        amp = float(rng.uniform(0.6, 1.0))
+        for t in range(frames_per_clip):
+            if i >= n:
+                break
+            cx, cy = x0 + vx * t, y0 + vy * t
+            img = amp * np.exp(-(np.square(xx - cx) + np.square(yy - cy))
+                               / (2 * sigma ** 2))
+            img += rng.normal(0.0, noise, img.shape).astype(np.float32)
+            data[i] = np.clip(img, 0.0, 1.0)
+            clip_ids[i] = clip
+            i += 1
+        clip += 1
+    return data, clip_ids
+
+
 def load_or_generate(path: Optional[str], generator, *args, **kwargs):
     """If ``path`` exists, load arrays ``data``/``labels`` from the .npz;
     otherwise call the generator (the no-real-data fallback)."""

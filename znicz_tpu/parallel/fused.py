@@ -51,6 +51,8 @@ class FusedTrainer:
         if remat is None:
             remat = bool(root.common.engine.get("remat", False))
         self.remat = remat
+        self.scan_chunk = int(root.common.engine.get("scan_chunk",
+                                                     type(self).scan_chunk))
         self.workflow = workflow
         self.forwards = list(workflow.forwards)
         self.loader = workflow.loader

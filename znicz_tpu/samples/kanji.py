@@ -1,0 +1,101 @@
+"""Kanji sample (SURVEY §1 L10 lists Kanji among the reference's
+``znicz/samples/``): many-class glyph classification — the regime that
+stresses the wide-softmax head and per-class balancing, unlike the
+10-class MNIST/CIFAR anchors.
+
+Data is the procedural stroke-composition set (``datasets.kanji``: each
+class a fixed random arrangement of stroke segments) unless
+``root.kanji.loader.data_path`` points at a real .npz.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+root.kanji.defaults({
+    "loader": {"minibatch_size": 128, "n_train": 4096, "n_valid": 512,
+               "n_test": 0, "n_classes": 64, "data_path": ""},
+    "learning_rate": 0.03,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0001,
+    "decision": {"max_epochs": 8, "fail_iterations": 0},
+    "snapshotter": {"prefix": "kanji", "interval": 0},
+})
+
+
+class KanjiLoader(FullBatchLoader):
+    def load_data(self):
+        cfg = root.kanji.loader
+        n_train = int(cfg.get("n_train"))
+        n_valid = int(cfg.get("n_valid"))
+        n_test = int(cfg.get("n_test"))
+        total = n_train + n_valid + n_test
+        data, labels = datasets.load_or_generate(
+            cfg.get("data_path") or None, datasets.kanji, total,
+            n_classes=int(cfg.get("n_classes")))
+        self.original_data.mem = data[..., None]        # NHWC, C=1
+        self.original_labels.mem = labels
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+
+def make_layers(n_classes):
+    cfg = root.kanji
+    gd = {"learning_rate": float(cfg.get("learning_rate")),
+          "gradient_moment": float(cfg.get("gradient_moment")),
+          "weights_decay": float(cfg.get("weights_decay"))}
+    return [
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 16, "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 32, "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 128},
+         "<-": dict(gd)},
+        {"type": "softmax", "->": {"output_sample_shape": n_classes},
+         "<-": dict(gd)},
+    ]
+
+
+class KanjiWorkflow(StandardWorkflow):
+    def __init__(self, **kwargs):
+        cfg = root.kanji
+        loader = KanjiLoader(
+            name="loader",
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        super().__init__(
+            name="KanjiWorkflow", loader=loader,
+            layers=make_layers(int(cfg.loader.get("n_classes"))),
+            loss_function="softmax",
+            decision_config={
+                "max_epochs": int(cfg.decision.get("max_epochs")),
+                "fail_iterations": int(cfg.decision.get("fail_iterations"))},
+            snapshotter_config={
+                "prefix": cfg.snapshotter.get("prefix"),
+                "interval": int(cfg.snapshotter.get("interval", 0))},
+            **kwargs)
+
+
+def run(snapshot: str = "", device=None) -> KanjiWorkflow:
+    wf = KanjiWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        from znicz_tpu.snapshotter import Snapshotter
+
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    from znicz_tpu.engine import train
+
+    train(wf)
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

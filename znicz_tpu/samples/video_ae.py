@@ -1,0 +1,93 @@
+"""VideoAE sample (SURVEY §1 L10 lists VideoAE among the reference's
+``znicz/samples/``): an autoencoder trained on video FRAMES — the
+reference compressed video by learning the frame manifold.  Data is the
+procedural moving-blob clip set (``datasets.videoframes``); the declarative
+StandardWorkflow build with ``loss_function="mse"`` wires EvaluatorMSE /
+DecisionMSE (targets = the frames themselves).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu import datasets
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.fullbatch import FullBatchLoaderMSE
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+root.video_ae.defaults({
+    "loader": {"minibatch_size": 100, "n_train": 2000, "n_valid": 400,
+               "n_test": 0, "data_path": ""},
+    "latent": 24,
+    "learning_rate": 0.05,       # tuned: see tests/test_samples_ext.py
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0,
+    "decision": {"max_epochs": 20, "fail_iterations": 0},
+    "snapshotter": {"prefix": "video_ae", "interval": 0},
+})
+
+
+class VideoAELoader(FullBatchLoaderMSE):
+    def load_data(self):
+        cfg = root.video_ae.loader
+        n_train = int(cfg.get("n_train"))
+        n_valid = int(cfg.get("n_valid"))
+        n_test = int(cfg.get("n_test"))
+        total = n_train + n_valid + n_test
+        data, _ = datasets.load_or_generate(
+            cfg.get("data_path") or None, datasets.videoframes, total)
+        self.original_data.mem = np.asarray(data, np.float32)
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+
+def make_layers(frame_shape):
+    cfg = root.video_ae
+    gd = {"learning_rate": float(cfg.get("learning_rate")),
+          "gradient_moment": float(cfg.get("gradient_moment")),
+          "weights_decay": float(cfg.get("weights_decay"))}
+    latent = int(cfg.get("latent"))
+    return [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": latent},
+         "<-": dict(gd)},
+        {"type": "all2all", "->": {"output_sample_shape": frame_shape},
+         "<-": dict(gd)},
+    ]
+
+
+class VideoAEWorkflow(StandardWorkflow):
+    def __init__(self, **kwargs):
+        cfg = root.video_ae
+        loader = VideoAELoader(
+            name="loader", targets_from_data=True,
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        super().__init__(
+            name="VideoAEWorkflow", loader=loader,
+            layers=make_layers((16, 16)),
+            loss_function="mse",
+            decision_config={
+                "max_epochs": int(cfg.decision.get("max_epochs")),
+                "fail_iterations": int(cfg.decision.get("fail_iterations"))},
+            snapshotter_config={
+                "prefix": cfg.snapshotter.get("prefix"),
+                "interval": int(cfg.snapshotter.get("interval", 0))},
+            **kwargs)
+
+
+def run(snapshot: str = "", device=None) -> VideoAEWorkflow:
+    wf = VideoAEWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        from znicz_tpu.snapshotter import Snapshotter
+
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    from znicz_tpu.engine import train
+
+    train(wf)
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

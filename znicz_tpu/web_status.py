@@ -28,12 +28,17 @@ class WebStatus:
         self.host = host
         self.port = int(port)
         self.workflows: List[object] = []
+        self.server = None                  # optional master (topology)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def register(self, workflow) -> None:
         if workflow not in self.workflows:
             self.workflows.append(workflow)
+
+    def register_server(self, server) -> None:
+        """Show the master/slave topology (reference dashboard feature)."""
+        self.server = server
 
     # -- snapshotting the state (host side, lock-free reads) -------------------
 
@@ -64,6 +69,21 @@ class WebStatus:
                                            else float(u.best_metric))
                     info["complete"] = bool(u.complete)
             out["workflows"].append(info)
+        if self.server is not None:
+            import time as _time
+
+            now = _time.time()
+            out["master"] = {
+                "endpoint": self.server.endpoint,
+                "jobs_done": self.server.jobs_done,
+                "jobs_requeued": self.server.jobs_requeued,
+                "stale_updates": self.server.stale_updates,
+                "slaves": [
+                    {"id": sid,
+                     "jobs": self.server.jobs_by_slave.get(sid, 0),
+                     "last_seen_s": round(now - seen, 1)}
+                    for sid, seen in sorted(self.server.slaves.items())],
+            }
         return out
 
     # -- server ----------------------------------------------------------------
