@@ -100,3 +100,70 @@ def test_mha_unit_fwd_bwd():
         num = (loss(wp) - loss(wm)) / (2 * eps)
         assert abs(num - dW[idx]) < 5e-2 * max(1.0, abs(num)), idx
     assert np.array(gd.err_input.map_read()).shape == x.shape
+
+def test_sequence_parallel_training_grads_match_and_learn():
+    """Long-context training end-to-end: grads flow THROUGH ring attention
+    under shard_map over an ('sp',) mesh, match the single-device
+    computation exactly, and a few SGD steps reduce the loss."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from znicz_tpu.ops.attention import attention, ring_attention
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    B, T, H, D, E = 2, 32, 2, 8, 16
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(B, T, E)).astype(np.float32))
+    y = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+    params = {k: jnp.asarray(rng.normal(size=(E, H * D)).astype(np.float32)
+                             / np.sqrt(E))
+              for k in ("wq", "wk", "wv")}
+    params["wo"] = jnp.asarray(
+        rng.normal(size=(H * D, E)).astype(np.float32) / np.sqrt(H * D))
+
+    def model(p, x, ring):
+        b, t, e = x.shape
+        q = (x @ p["wq"]).reshape(b, t, H, D)
+        k = (x @ p["wk"]).reshape(b, t, H, D)
+        v = (x @ p["wv"]).reshape(b, t, H, D)
+        o = (ring_attention(q, k, v, "sp", causal=True) if ring
+             else attention(q, k, v, causal=True))
+        return o.reshape(b, t, H * D) @ p["wo"]
+
+    mesh = make_mesh((8,), ("sp",))
+
+    def sp_loss(p, x, y):
+        # x/y arrive sequence-sharded: (B, T/8, E) per device
+        out = model(p, x, ring=True)
+        local = jnp.mean(jnp.square(out - y))
+        return jax.lax.pmean(local, "sp")
+
+    spec = P(None, "sp", None)
+    sharded_loss = shard_map(sp_loss, mesh=mesh, in_specs=(P(), spec, spec),
+                             out_specs=P())
+
+    def ref_loss(p, x, y):
+        return jnp.mean(jnp.square(model(p, x, ring=False) - y))
+
+    g_sp = jax.jit(jax.grad(sharded_loss))(params, x, y)
+    g_ref = jax.jit(jax.grad(ref_loss))(params, x, y)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_sp[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+
+    # a few sequence-parallel SGD steps actually learn
+    @jax.jit
+    def step(p, x, y):
+        loss, g = jax.value_and_grad(sharded_loss)(p, x, y)
+        return {k: p[k] - 0.3 * g[k] for k in p}, loss
+
+    losses = []
+    p = params
+    for _ in range(30):
+        p, loss = step(p, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < 0.8 * losses[0], losses
+    assert all(b <= a + 1e-6 for a, b in zip(losses, losses[1:])), losses

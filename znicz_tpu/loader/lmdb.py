@@ -6,9 +6,13 @@ implements the LMDB **on-disk format itself** (the format of
 ``liblmdb``'s ``data.mdb``):
 
   - ``MDBReader``: zero-copy mmap reader — meta-page election by txnid,
-    B+tree descent over branch/leaf pages, overflow-page values.  Reads
-    databases produced by real liblmdb (single unnamed main DB, default
-    flags) as well as by ``MDBWriter``.
+    B+tree descent over branch/leaf pages, overflow-page values.  Designed
+    to read databases produced by real liblmdb (single unnamed main DB,
+    default flags) as well as by ``MDBWriter``.  ⚠ The real-liblmdb half
+    of that claim is UNVERIFIED in this environment: no liblmdb binding or
+    ``data.mdb`` fixture exists here, so tests cover writer->reader
+    round-trips and spec-conformance of the constants only; exercise
+    against a real ``data.mdb`` before relying on it (VERDICT r2 weak #8).
   - ``MDBWriter``: bulk writer producing a spec-conformant file: meta pages
     0/1 (page size recorded in FREE-db md_pad, as liblmdb does), sorted
     leaf pages, branch levels up to a single root, ``F_BIGDATA`` overflow
