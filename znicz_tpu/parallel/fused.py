@@ -472,6 +472,30 @@ class FusedTrainer:
         was_indices_only = loader.indices_only
         loader.indices_only = True
         pending = None                  # an advanced-but-unprocessed mb
+        inflight = None                 # (seg, kind, device results, t0)
+
+        def flush():
+            """Sync + feed the in-flight TRAIN segment's metrics.  Runs
+            AFTER the next segment is dispatched, so the host round-trip
+            overlaps device compute (one-deep pipeline); non-tail TRAIN
+            feeds cannot flip `complete`/`gd_skip`, so deferring them one
+            segment changes no control flow — tails/eval flush first."""
+            nonlocal inflight
+            if inflight is None:
+                return
+            seg, kind, res, t0 = inflight
+            inflight = None
+            if kind == "single":
+                stacked = [res]
+            else:
+                losses, n_errs, confs = (np.asarray(m) for m in res)
+                stacked = [(losses[i], n_errs[i], confs[i])
+                           for i in range(len(seg))]
+            for s, m in zip(seg, stacked):
+                feed_decision(s, m)
+            account(len(seg), sum(s["size"] for s in seg),
+                    _time.perf_counter() - t0, True)
+
         try:
             while not bool(decision.complete):
                 t_iter = _time.perf_counter()
@@ -499,7 +523,7 @@ class FusedTrainer:
                             params, velocities, self.hypers(), dataset,
                             targets, put(seg[0]["idx"]),
                             np.int32(seg[0]["size"]), key)
-                        stacked = [metrics]
+                        result = ("single", metrics)
                     else:
                         idx_mat = put(np.stack([s["idx"] for s in seg]))
                         bs_vec = put(np.array([s["size"] for s in seg],
@@ -511,16 +535,12 @@ class FusedTrainer:
                             params, velocities, self.hypers(), dataset,
                             targets, idx_mat, bs_vec,
                             put(gen.jax_base_key()), put(steps))
-                        losses, n_errs, confs = (np.asarray(m)
-                                                 for m in ms)
-                        stacked = [(losses[i], n_errs[i], confs[i])
-                                   for i in range(len(seg))]
+                        result = ("scan", ms)
                     self.steps_done += len(seg)
-                    for s, m in zip(seg, stacked):
-                        feed_decision(s, m)
-                    account(len(seg), sum(s["size"] for s in seg),
-                            _time.perf_counter() - t_iter, True)
+                    flush()             # previous segment, AFTER dispatch
+                    inflight = (seg, result[0], result[1], t_iter)
                 elif is_train:
+                    flush()
                     # epoch tail: metrics first, Decision rules, and the
                     # update applies only if gd_skip stayed open
                     # (unit-path parity)
@@ -538,6 +558,7 @@ class FusedTrainer:
                     account(1, mb["size"], _time.perf_counter() - t_iter,
                             True)
                 else:
+                    flush()
                     # TEST/VALID: params are frozen, so consecutive eval
                     # minibatches scan as a pure map in one dispatch
                     seg = [mb]
@@ -569,6 +590,7 @@ class FusedTrainer:
                             False)
                 if bool(decision.epoch_ended):
                     epoch_end_hook()
+            flush()
             self.writeback(params, velocities)
         finally:
             loader.indices_only = was_indices_only
