@@ -68,13 +68,13 @@ def test_master_slave_trains(tmp_path):
     valid = dec.epoch_metrics[1]
     assert valid is not None and valid["err_pct"] < 70.0, valid
 
-def _register(sock, slave_id):
+def _register(sock, slave_id, workflow):
     """Raw-socket handshake (the Client's own first message)."""
     import pickle
 
     from znicz_tpu.network_common import handshake_request
 
-    msg = handshake_request()
+    msg = handshake_request(workflow)
     msg["id"] = slave_id
     sock.send(pickle.dumps(msg))
     return pickle.loads(sock.recv())
@@ -103,7 +103,7 @@ def test_slave_death_requeues_job_and_training_completes(tmp_path):
     doomed.setsockopt(zmq.RCVTIMEO, 10_000)
     doomed.setsockopt(zmq.LINGER, 0)
     doomed.connect(endpoint)
-    assert _register(doomed, "doomed")["ok"]
+    assert _register(doomed, "doomed", master_wf)["ok"]
     doomed.send(pickle.dumps({"cmd": "job", "id": "doomed"}))
     rep = pickle.loads(doomed.recv())
     assert "job" in rep and "params" in rep
@@ -136,7 +136,7 @@ def test_stale_update_dropped_deterministic(tmp_path):
     master_wf = _make_workflow(tmp_path / "m")
     server = Server(master_wf, job_timeout=0.0)   # reap instantly
     assert server._handle({"cmd": "register", "id": "s1",
-                           **_handshake_fields()})["ok"]
+                           **_handshake_fields(master_wf)})["ok"]
     rep = server._handle({"cmd": "job", "id": "s1"})
     jid = rep["job_id"]
     time.sleep(0.01)
@@ -173,33 +173,34 @@ def test_midrun_joiner_receives_current_weights(tmp_path):
     current = np.array(first.weights.map_read())
 
     assert server._handle({"cmd": "register", "id": "late",
-                           **_handshake_fields()})["ok"]
+                           **_handshake_fields(master_wf)})["ok"]
     rep = server._handle({"cmd": "job", "id": "late"})
     assert "params" in rep
     got = np.asarray(rep["params"][first.name]["weights"])
     np.testing.assert_array_equal(got, current)
 
 
-def _handshake_fields():
+def _handshake_fields(workflow):
     from znicz_tpu.network_common import handshake_request
 
-    msg = handshake_request()
+    msg = handshake_request(workflow)
     del msg["cmd"]
     return msg
 
 
 def test_handshake_version_mismatch_refused(tmp_path):
-    from znicz_tpu.network_common import config_digest
+    from znicz_tpu.network_common import workflow_digest
     from znicz_tpu.server import Server
 
-    server = Server(_make_workflow(tmp_path / "m"))
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
     rep = server._handle({"cmd": "register", "id": "old", "version": 999,
-                          "config_digest": config_digest()})
+                          "workflow_digest": workflow_digest(master_wf)})
     assert rep["ok"] is False and "version mismatch" in rep["error"]
     assert "old" not in server.slaves
     # a compatible peer still registers fine afterwards
     assert server._handle({"cmd": "register", "id": "new",
-                           **_handshake_fields()})["ok"]
+                           **_handshake_fields(master_wf)})["ok"]
 
 
 def test_handshake_digest_mismatch_refused_client_side(tmp_path):
@@ -238,11 +239,9 @@ def test_handshake_digest_mismatch_refused_client_side(tmp_path):
 
     from znicz_tpu import network_common
 
-    # patch the CLIENT's handshake only (config_digest itself is shared by
-    # both peers in this single-process test, so patching it would keep
-    # them in agreement)
+    # the CLIENT's workflow really differs: narrower hidden layer
     bad = {"cmd": "register", "version": network_common.PROTOCOL_VERSION,
-           "config_digest": "deadbeefdeadbeef"}
+           "workflow_digest": "deadbeefdeadbeef"}
     with mock.patch.object(network_common, "handshake_request",
                            return_value=bad):
         with pytest.raises(RuntimeError, match="digest mismatch"):
@@ -250,22 +249,29 @@ def test_handshake_digest_mismatch_refused_client_side(tmp_path):
     t.join(timeout=10)
 
 
-def test_config_digest_ignores_host_local_paths():
-    """Host-local paths (snapshot dirs, data_path) differ per machine and
-    must not fail the handshake; model config changes must."""
-    from znicz_tpu.network_common import config_digest
+def test_workflow_digest_semantics(tmp_path):
+    """The digest is the weight-delta contract: identical replicas match
+    (even across different host paths / unrelated imported config), and a
+    changed trainable graph or hyperparameter mismatches."""
+    from znicz_tpu.network_common import workflow_digest
 
-    base = config_digest()
-    root.common.dirs.snapshots = "/somewhere/else/entirely"
-    root.mnist.loader.data_path = "/mnt/other/mnist.npz"
-    assert config_digest() == base
-    old = root.mnist.loader.minibatch_size
-    try:
-        root.mnist.loader.minibatch_size = int(old) + 1
-        assert config_digest() != base      # model config DOES matter
-    finally:
-        root.mnist.loader.minibatch_size = old
-        root.mnist.loader.data_path = ""
+    a = _make_workflow(tmp_path / "a")
+    root.common.dirs.snapshots = "/somewhere/else/entirely"   # host-local
+    root.unrelated_sample.defaults({"x": 1})    # unrelated imported config
+    b = _make_workflow(tmp_path / "b")
+    assert workflow_digest(a) == workflow_digest(b)
+
+    old_lr = b.gds[0].learning_rate
+    b.gds[0].learning_rate = old_lr * 2         # hyperparameter mismatch
+    assert workflow_digest(a) != workflow_digest(b)
+    b.gds[0].learning_rate = old_lr
+    assert workflow_digest(a) == workflow_digest(b)
+
+    w = a.forwards[0].weights
+    import numpy as np_
+
+    w.mem = np_.zeros((w.shape[0] + 1, w.shape[1]), np_.float32)
+    assert workflow_digest(a) != workflow_digest(b)   # shape mismatch
 
 
 def test_unregistered_slave_gets_no_jobs_or_updates(tmp_path):
@@ -299,7 +305,7 @@ def test_web_status_shows_master_topology(tmp_path):
     master_wf = _make_workflow(tmp_path / "m")
     server = Server(master_wf)
     assert server._handle({"cmd": "register", "id": "s1",
-                           **_handshake_fields()})["ok"]
+                           **_handshake_fields(master_wf)})["ok"]
     server._handle({"cmd": "job", "id": "s1"})
 
     status = WebStatus(port=0).start()
@@ -316,3 +322,54 @@ def test_web_status_shows_master_topology(tmp_path):
         assert snap["workflows"][0]["name"] == master_wf.name
     finally:
         status.stop()
+
+
+def test_launcher_master_slave_modes(tmp_path):
+    """The reference CLI's --master/--slave surface (SURVEY §3.1): the
+    launcher serves the workflow as the async master / works as a slave
+    instead of training locally."""
+    import os
+    import subprocess
+    import sys
+
+    import znicz_tpu
+    from znicz_tpu import launcher
+
+    endpoint = "tcp://127.0.0.1:17574"
+    overrides = ["root.mnist.loader.n_train=300",
+                 "root.mnist.loader.n_valid=60",
+                 "root.mnist.loader.minibatch_size=60",
+                 "root.mnist.decision.max_epochs=2",
+                 f"root.common.dirs.snapshots={tmp_path}"]
+
+    # mutual exclusion is a clean CLI error
+    assert launcher.main(["mnist", "--master", "--slave", endpoint]) == 2
+
+    repo = os.path.dirname(os.path.dirname(znicz_tpu.__file__))
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    slave = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "mnist", *overrides,
+         "--slave", endpoint], env=env, cwd=str(tmp_path),
+        stderr=subprocess.PIPE, text=True)
+
+    rc = {}
+
+    def master():
+        rc["master"] = launcher.main(
+            ["mnist", *overrides, "--master", endpoint])
+
+    t = threading.Thread(target=master, daemon=True)
+    try:
+        t.start()
+        slave_rc = slave.wait(timeout=240)
+        assert slave_rc == 0, slave.stderr.read()[-3000:]
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert rc.get("master") == 0
+    finally:
+        root.common.engine.mode = ""
+        if slave.poll() is None:
+            slave.kill()

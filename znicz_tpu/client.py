@@ -88,7 +88,7 @@ class Client:
         ctx = zmq.Context.instance()
         sock = self._connect(ctx, int(recv_timeout * 1000))
         try:
-            rep = self._rpc(sock, handshake_request())
+            rep = self._rpc(sock, handshake_request(self.workflow))
             if not rep.get("ok"):
                 raise RuntimeError(
                     f"master refused registration: {rep.get('error')}")

@@ -17,8 +17,38 @@ def wants_fused() -> bool:
     return bool(root.common.engine.get("fused", False))
 
 
+def _check_distributable(workflow, mode: str) -> None:
+    missing = [a for a in ("forwards", "loader", "decision")
+               if getattr(workflow, a, None) is None]
+    if missing:
+        raise ValueError(
+            f"--{mode} needs a StandardWorkflow-shaped graph; "
+            f"{workflow.name} lacks {missing}")
+
+
 def train(workflow) -> None:
-    """Train ``workflow`` with the configured engine."""
+    """Train ``workflow`` with the configured engine/mode.
+
+    ``root.common.engine.mode`` (the launcher's ``--master``/``--slave``)
+    switches to the asynchronous parameter-server roles — the reference's
+    CLI distribution surface (SURVEY §3.1/§3.4) — instead of local
+    training."""
+    mode = root.common.engine.get("mode", "")
+    if mode == "master":
+        from znicz_tpu.server import Server
+
+        _check_distributable(workflow, mode)
+        Server(workflow,
+               endpoint=root.common.engine.get("master_bind",
+                                               "tcp://*:5570")).serve()
+        return
+    if mode == "slave":
+        from znicz_tpu.client import Client
+
+        _check_distributable(workflow, mode)
+        Client(workflow,
+               endpoint=root.common.engine.get("slave_endpoint")).run()
+        return
     if wants_fused() and all(
             getattr(workflow, a, None) is not None
             for a in ("forwards", "gds", "loader", "decision")):

@@ -11,8 +11,14 @@ A workflow script is any python file/module exposing ``run(snapshot=...,
 device=...) -> workflow`` (all the bundled samples do); a config file is any
 python file mutating ``znicz_tpu.core.config.root`` (applied before the
 workflow module loads, then CLI dotted overrides on top — reference
-precedence).  The reference's ``--master``/``--slave`` flags have no
-equivalent: distribution is SPMD inside the jitted step (SURVEY.md §2.4).
+precedence).
+
+Distribution: the PRIMARY mode is SPMD inside the jitted step (SURVEY.md
+§2.4) — no flags needed.  The reference's ``--master``/``--slave`` CLI
+surface is preserved for the asynchronous parameter-server mode
+(server.py/client.py): ``--master [bind]`` builds the workflow and serves
+jobs instead of training locally; ``--slave endpoint`` builds the local
+replica and works for that master.
 """
 
 from __future__ import annotations
@@ -67,6 +73,15 @@ class Launcher:
                             help="train with the fused SPMD fast path "
                                  "(one jitted scan step) instead of the "
                                  "unit-at-a-time engine")
+        parser.add_argument("--master", nargs="?", const="tcp://*:5570",
+                            default=None, metavar="BIND",
+                            help="serve this workflow as the async "
+                                 "parameter-server master instead of "
+                                 "training locally (default bind "
+                                 "tcp://*:5570)")
+        parser.add_argument("--slave", default=None, metavar="ENDPOINT",
+                            help="work for the master at ENDPOINT "
+                                 "(e.g. tcp://host:5570)")
         parser.add_argument("--fitness", action="store_true",
                             help="print a final JSON line with the run's "
                                  "fitness (genetics subprocess evaluation)")
@@ -89,6 +104,16 @@ class Launcher:
             root.common.engine.backend = args.backend
         if args.fused:
             root.common.engine.fused = True
+        if args.master is not None and args.slave is not None:
+            print("error: --master and --slave are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        if args.master is not None:
+            root.common.engine.mode = "master"
+            root.common.engine.master_bind = args.master
+        elif args.slave is not None:
+            root.common.engine.mode = "slave"
+            root.common.engine.slave_endpoint = args.slave
         if args.seed is not None:
             from znicz_tpu.core import prng
 

@@ -160,7 +160,7 @@ class Server:
             from znicz_tpu.network_common import (PROTOCOL_VERSION,
                                                   check_handshake)
 
-            refusal = check_handshake(req)
+            refusal = check_handshake(req, self.workflow)
             if refusal:
                 self.slaves.pop(sid, None)      # refused != member
                 self.registered.discard(sid)
