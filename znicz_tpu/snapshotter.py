@@ -114,12 +114,20 @@ class Snapshotter(Unit):
             "directory", root.common.dirs.get("snapshots", "snapshots"))
         self.interval = int(kwargs.get("interval", 0))   # 0 = best-only
         self.compression = kwargs.get("compression", "gz")
+        #: "pickle" (reference-style single file) or "orbax" (TPU-native
+        #: tensorstore checkpoint dir + meta.json — SURVEY §3.5 rebuild
+        #: note); also settable via root.common.engine.snapshot_format
+        self.format = kwargs.get(
+            "format", root.common.engine.get("snapshot_format", "pickle"))
         self.destination: Optional[str] = None            # last written path
         self.improved = False                             # link from decision
         self.epoch_number = 0                             # link from decision
         self._last_saved_epoch = -1
 
     def snapshot_path(self, tag: str) -> str:
+        if self.format == "orbax":
+            return os.path.join(self.directory,
+                                f"{self.prefix}_{tag}.orbax")
         ext = ".pickle.gz" if self.compression == "gz" else ".pickle"
         return os.path.join(self.directory, f"{self.prefix}_{tag}{ext}")
 
@@ -128,9 +136,12 @@ class Snapshotter(Unit):
         snap = collect(self.workflow)
         snap["config"] = root.to_dict()
         path = self.snapshot_path(tag)
-        opener = gzip.open if self.compression == "gz" else open
-        with opener(path, "wb") as f:
-            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if self.format == "orbax":
+            _save_orbax(path, snap)
+        else:
+            opener = gzip.open if self.compression == "gz" else open
+            with opener(path, "wb") as f:
+                pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
         self.destination = path
         self.info("snapshot -> %s", path)
         return path
@@ -146,6 +157,52 @@ class Snapshotter(Unit):
 
     @staticmethod
     def load(path: str) -> Dict:
+        if path.rstrip("/").endswith(".orbax") or os.path.isdir(path):
+            return _load_orbax(path.rstrip("/"))
         opener = gzip.open if path.endswith(".gz") else open
         with opener(path, "rb") as f:
             return pickle.load(f)
+
+
+_ORBAX_CKPTR = None
+
+
+def _orbax_checkpointer():
+    """One long-lived StandardCheckpointer: per-call instances tear down
+    orbax's async executor each time, which races interpreter shutdown."""
+    global _ORBAX_CKPTR
+    if _ORBAX_CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _ORBAX_CKPTR = ocp.StandardCheckpointer()
+    return _ORBAX_CKPTR
+
+
+def _save_orbax(path: str, snap: Dict) -> None:
+    """TPU-native checkpoint layout: the weight/velocity pytrees go through
+    orbax/tensorstore (sharded-array-capable, no pickled code), everything
+    else (loader/decision/prng/config metadata) is a JSON sidecar."""
+    import json
+    import shutil
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.makedirs(path)
+    arrays = {"units": snap["units"], "velocities": snap["velocities"]}
+    _orbax_checkpointer().save(os.path.join(path, "arrays"), arrays)
+    meta = {k: v for k, v in snap.items()
+            if k not in ("units", "velocities")}
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, default=repr)      # inf/nan: python-json style
+
+
+def _load_orbax(path: str) -> Dict:
+    import json
+
+    arrays = _orbax_checkpointer().restore(
+        os.path.join(os.path.abspath(path), "arrays"))
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    return {**meta, "units": arrays["units"],
+            "velocities": arrays["velocities"]}
