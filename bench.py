@@ -12,7 +12,9 @@ Protocol (unsoftened AlexNet — VERDICT r1 item 3):
     varies per step and per epoch (reshuffle), nothing is cached;
   - the whole timed window is ONE ``lax.scan`` dispatch of STEPS train
     steps (the FusedTrainer's own scan path) — one executable launch, so
-    the number measures device math, not per-dispatch link latency;
+    the number measures device math, not per-dispatch link latency; the
+    headline is the MEDIAN of three independently-timed windows
+    (``elapsed_s_runs`` records all three);
   - a jax.profiler trace of a post-timing scan lands in ``bench_profile/``
     (best-effort: some remote platforms cannot trace).
 
@@ -217,13 +219,30 @@ def main(legacy: bool = False) -> None:
         scan, params, vels, hypers, dataset, targets, idx_mat, bs_vec,
         base_key, steps_from(0))
 
-    idx_mat, bs_vec = draw_minibatches(STEPS)
-    steps = steps_from(STEPS)
-    t0 = time.perf_counter()
-    params, vels, ms = scan(params, vels, hypers, dataset, targets,
-                            idx_mat, bs_vec, base_key, steps)
-    materialize(params, ms[0])
-    elapsed = time.perf_counter() - t0
+    # three independently-timed windows, each restarted from the SAME
+    # post-warmup state (device copies; the timed scans donate the
+    # copies).  Restarting matters: letting the windows keep training
+    # (800+ steps over 1024 resident images) drives the net into
+    # bf16-overflow territory — the bench's own NaN check caught that.
+    # The MEDIAN is the headline — robust to a one-off host/tunnel hiccup.
+    import jax.numpy as jnp
+
+    base_params = jax.tree_util.tree_map(jnp.copy, params)
+    base_vels = jax.tree_util.tree_map(jnp.copy, vels)
+    runs = []
+    losses_per_run = []
+    for r in range(3):
+        idx_mat, bs_vec = draw_minibatches(STEPS)
+        p = jax.tree_util.tree_map(jnp.copy, base_params)
+        v = jax.tree_util.tree_map(jnp.copy, base_vels)
+        t0 = time.perf_counter()        # ~1ms of copies may drain in-queue
+        p, v, ms = scan(p, v, hypers, dataset, targets,
+                        idx_mat, bs_vec, base_key, steps_from(STEPS))
+        materialize(p, ms[0])
+        runs.append(time.perf_counter() - t0)
+        losses_per_run.append(ms[0])
+    elapsed = float(np.median(runs))
+    ms = (losses_per_run[int(np.argsort(runs)[1])],)
 
     # the timed window must be REAL training: every loss finite, and the
     # trajectory (warmup start -> timed tail) clearly descending.  The tail
@@ -260,6 +279,7 @@ def main(legacy: bool = False) -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / K40_ALEXNET_IMG_S, 3),
         "batch": BATCH, "steps": STEPS, "elapsed_s": round(elapsed, 4),
+        "elapsed_s_runs": [round(r, 4) for r in runs],
         "flops_per_step": flops_step,
         "xla_flops_per_step": xla_flops_step,
         "flops_convention": "2*MACs, train=3x fwd, conv+GEMM only",
