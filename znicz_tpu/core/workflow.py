@@ -157,13 +157,17 @@ class Workflow(Unit):
                          f"{100.0 * u.run_time / total:>8.1f}")
         fused = getattr(self, "fused_stats", None)
         if fused and fused.get("wall_s"):
-            lines.append(
-                f"fused: {fused['train_steps']} train + "
-                f"{fused['eval_steps']} eval steps in "
-                f"{fused['wall_s']:.3f}s  "
-                f"({fused['steps_per_sec']} steps/s, "
-                f"{fused['img_per_sec']} img/s, "
-                f"last {fused['last_step_ms']} ms)")
+            line = (f"fused: {fused['train_steps']} train + "
+                    f"{fused['eval_steps']} eval steps in "
+                    f"{fused['wall_s']:.3f}s  "
+                    f"({fused['steps_per_sec']} steps/s, "
+                    f"{fused['img_per_sec']} img/s, "
+                    f"last {fused['last_step_ms']} ms)")
+            if fused.get("warm_steps"):
+                line += (f"; warm (excl. compiles): "
+                         f"{fused['warm_img_per_sec']} img/s over "
+                         f"{fused['warm_steps']} steps")
+            lines.append(line)
         table = "\n".join(lines)
         self.info("unit timing:\n%s", table)
         return table

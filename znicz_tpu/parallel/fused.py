@@ -85,9 +85,14 @@ class FusedTrainer:
         #: the fast path reports like the unit path's timing table does);
         #: surfaced by Workflow.print_stats and web_status /status.json
         #: via ``workflow.fused_stats``
+        #: ``warm_*`` exclude each dispatch kind's FIRST call (which pays
+        #: jit compilation) — the steady-state numbers; ``wall_s`` etc.
+        #: are totals including compiles
         self.stats = {"train_steps": 0, "eval_steps": 0, "images": 0,
                       "wall_s": 0.0, "steps_per_sec": 0.0,
-                      "img_per_sec": 0.0, "last_step_ms": 0.0}
+                      "img_per_sec": 0.0, "last_step_ms": 0.0,
+                      "warm_steps": 0, "warm_images": 0, "warm_wall_s": 0.0,
+                      "warm_img_per_sec": 0.0}
         workflow.fused_stats = self.stats
         self.compute_dtype = (np.dtype("float32")
                               if root.common.engine.get("precision",
@@ -438,7 +443,9 @@ class FusedTrainer:
                 decision.confusion_matrix = np.asarray(conf)
             decision.run()
 
-        def account(n_steps, n_images, dt, is_train):
+        seen_kinds = set()
+
+        def account(n_steps, n_images, dt, is_train, kind="train"):
             stats["wall_s"] += dt
             stats["last_step_ms"] = round(dt / n_steps * 1e3, 3)
             if is_train:
@@ -450,6 +457,14 @@ class FusedTrainer:
             stats["steps_per_sec"] = round(total / stats["wall_s"], 2)
             stats["img_per_sec"] = round(
                 stats["images"] / stats["wall_s"], 2)
+            if kind in seen_kinds:      # first call of a kind pays compile
+                stats["warm_steps"] += n_steps
+                stats["warm_images"] += n_images
+                stats["warm_wall_s"] += dt
+                if stats["warm_wall_s"] > 0:
+                    stats["warm_img_per_sec"] = round(
+                        stats["warm_images"] / stats["warm_wall_s"], 2)
+            seen_kinds.add(kind)
 
         def epoch_end_hook():
             self.writeback(params, velocities)
@@ -494,7 +509,8 @@ class FusedTrainer:
             for s, m in zip(seg, stacked):
                 feed_decision(s, m)
             account(len(seg), sum(s["size"] for s in seg),
-                    _time.perf_counter() - t0, True)
+                    _time.perf_counter() - t0, True,
+                    kind=f"train_{kind}_{len(seg)}")
 
         try:
             while not bool(decision.complete):
@@ -556,7 +572,7 @@ class FusedTrainer:
                             targets, idx, bs, key)
                     self.steps_done += 1
                     account(1, mb["size"], _time.perf_counter() - t_iter,
-                            True)
+                            True, kind="tail")
                 else:
                     flush()
                     # TEST/VALID: params are frozen, so consecutive eval
@@ -587,7 +603,7 @@ class FusedTrainer:
                     for s, m in zip(seg, stacked):
                         feed_decision(s, m)
                     account(len(seg), 0, _time.perf_counter() - t_iter,
-                            False)
+                            False, kind=f"eval_{len(seg)}")
                 if bool(decision.epoch_ended):
                     epoch_end_hook()
             flush()
