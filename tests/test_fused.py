@@ -204,9 +204,14 @@ def test_fused_stats_observability(tmp_path):
     assert s["images"] >= s["train_steps"]       # >= 1 image per step
     assert s["wall_s"] > 0 and s["steps_per_sec"] > 0
     assert s["img_per_sec"] > 0 and s["last_step_ms"] > 0
+    # warm numbers exclude each variant's first (compiling) dispatch
+    assert s["warm_steps"] > 0
+    assert s["warm_steps"] < s["train_steps"] + s["eval_steps"]
+    assert s["warm_img_per_sec"] > s["img_per_sec"]
     assert wf.fused_stats is s
     table = wf.print_stats()
     assert "steps/s" in table and "img/s" in table
+    assert "warm (excl. compiles)" in table
 
     status = WebStatus(port=0).start()
     try:
