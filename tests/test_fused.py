@@ -47,12 +47,23 @@ def run_fused(wf, mesh=None, tp_threshold=None):
 
 def test_fused_matches_unit_path(tmp_path):
     root.common.dirs.snapshots = str(tmp_path)
-    lu, wu = run_unit(fresh_mnist())
-    lf, wf_ = run_fused(fresh_mnist())
+    wfu = fresh_mnist()
+    lu, wu = run_unit(wfu)
+    wff = fresh_mnist()
+    lf, wf_ = run_fused(wff)
     np.testing.assert_allclose(lu, lf, rtol=1e-4)
     for name in wu:
         np.testing.assert_allclose(wu[name], wf_[name], rtol=2e-3,
                                    atol=2e-5, err_msg=name)
+    # confusion totals match exactly — the fused path accumulates the
+    # confusion on DEVICE across each epoch and transfers once at the
+    # tail, which must be invisible to the Decision's epoch metrics
+    for klass in (1, 2):
+        cu = wfu.decision.epoch_metrics[klass]["confusion"]
+        cf = wff.decision.epoch_metrics[klass]["confusion"]
+        np.testing.assert_array_equal(np.asarray(cu), np.asarray(cf),
+                                      err_msg=f"class {klass}")
+        assert np.asarray(cf).sum() > 0
 
 
 def test_fused_data_parallel_8dev_matches_single(tmp_path):

@@ -146,10 +146,13 @@ class DecisionGD(DecisionBase):
         self._acc_samples[klass] += int(self.minibatch_size)
         if self.confusion_matrix is not None:
             conf = np.asarray(self.confusion_matrix)
-            if self._acc_confusion[klass] is None:
-                self._acc_confusion[klass] = conf.copy()
-            else:
-                self._acc_confusion[klass] += conf
+            # size<=1 is the evaluator's confusion-disabled sentinel
+            # (wide heads skip the (C,C) reporting transfer)
+            if conf.size > 1:
+                if self._acc_confusion[klass] is None:
+                    self._acc_confusion[klass] = conf.copy()
+                else:
+                    self._acc_confusion[klass] += conf
 
     def _reset_class(self, klass: int) -> None:
         super()._reset_class(klass)

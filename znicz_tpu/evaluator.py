@@ -44,17 +44,26 @@ class EvaluatorBase(Unit):
 
 
 class EvaluatorSoftmax(EvaluatorBase):
+    #: heads wider than this default to confusion=off — a (C, C) int32
+    #: matrix shipped per minibatch/epoch is pure reporting, and at
+    #: ImageNet scale (1000x1000 = 4MB) it dominated training wall time
+    #: on slow host links; set ``compute_confusion=True`` to force it
+    CONFUSION_AUTO_LIMIT = 128
+
     def __init__(self, workflow=None, name=None, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.labels: Optional[Array] = None        # linked: minibatch_labels
         self.n_err = 0
         self.n_classes = kwargs.get("n_classes", 0)
+        self.compute_confusion = kwargs.get("compute_confusion", None)
         self.confusion_matrix = Array()            # (pred, true) counts
         self.max_err_output_sum = 0.0
 
     @staticmethod
-    def compute(probs, labels, batch_size, n_classes):
-        """Pure metrics+cotangent computation (jit-compiled once)."""
+    def compute(probs, labels, batch_size, n_classes, with_confusion=True):
+        """Pure metrics+cotangent computation (jit-compiled once).  With
+        ``with_confusion`` off the confusion slot is a (1, 1) zero —
+        DecisionGD treats size<=1 as "not collected"."""
         import jax.numpy as jnp
 
         n = probs.shape[0]
@@ -68,8 +77,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         ce = -jnp.log(jnp.maximum(
             jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0], eps))
         loss = jnp.sum(jnp.where(valid, ce, 0.0)) / jnp.maximum(batch_size, 1)
-        conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
-            pred, labels].add(valid.astype(jnp.int32))
+        if with_confusion:
+            conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+                pred, labels].add(valid.astype(jnp.int32))
+        else:
+            conf = jnp.zeros((1, 1), jnp.int32)
         max_err_sum = jnp.max(jnp.sum(jnp.abs(err), axis=-1))
         return err, n_err, loss, conf, max_err_sum
 
@@ -77,17 +89,22 @@ class EvaluatorSoftmax(EvaluatorBase):
         super().initialize(device=device, **kwargs)
         if not self.n_classes:
             self.n_classes = int(self.output.shape[-1])
-        self.confusion_matrix.mem = np.zeros(
-            (self.n_classes, self.n_classes), np.int32)
+        if self.compute_confusion is None:
+            self.compute_confusion = \
+                self.n_classes <= self.CONFUSION_AUTO_LIMIT
+        shape = ((self.n_classes, self.n_classes)
+                 if self.compute_confusion else (1, 1))
+        self.confusion_matrix.mem = np.zeros(shape, np.int32)
         self.confusion_matrix.initialize(device)
 
     def run(self):
         if self._compiled is None:
             import jax
-            self._compiled = jax.jit(self.compute, static_argnums=(3,))
+            self._compiled = jax.jit(self.compute, static_argnums=(3, 4))
         err, n_err, loss, conf, mes = self._compiled(
             self.output.devmem, self.labels.devmem,
-            np.int32(self.batch_size), self.n_classes)
+            np.int32(self.batch_size), self.n_classes,
+            bool(self.compute_confusion))
         self.err_output.devmem = err
         self.confusion_matrix.devmem = conf
         self.n_err = int(n_err)

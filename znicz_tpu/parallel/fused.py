@@ -61,6 +61,11 @@ class FusedTrainer:
         self.loss_kind = ("softmax"
                           if isinstance(workflow.evaluator, EvaluatorSoftmax)
                           else "mse")
+        #: mirrors the evaluator's resolved setting (auto-off for wide
+        #: heads: the (C,C) reporting transfer dominated training wall
+        #: time at ImageNet scale on slow host links)
+        self.compute_confusion = bool(
+            getattr(workflow.evaluator, "compute_confusion", True))
         self._softmax_cls = All2AllSoftmax
         self._dropout_cls = DropoutForward
         self._stochpool_cls = StochasticPoolingBase
@@ -206,9 +211,12 @@ class FusedTrainer:
             loss = jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
             pred = jnp.argmax(logits, axis=-1)
             n_err = jnp.sum((pred != labels) & valid)
-            n_classes = logits.shape[-1]
-            conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
-                pred, labels].add(valid.astype(jnp.int32))
+            if self.compute_confusion:
+                n_classes = logits.shape[-1]
+                conf = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+                    pred, labels].add(valid.astype(jnp.int32))
+            else:
+                conf = jnp.zeros((1, 1), jnp.int32)
             return loss, (loss, n_err, conf)
         else:
             y = out.reshape(n, -1)
@@ -307,19 +315,30 @@ class FusedTrainer:
         bench r3).  Metrics come back stacked, one per step."""
         import jax
 
+        import jax.numpy as jnp
+
+        nc = (self.forwards[-1].output_samples_number
+              if self.loss_kind == "softmax" and self.compute_confusion
+              else 1)
+
         def chunk(params, velocities, hypers, dataset, targets, idx_mat,
                   bs_vec, base_key, step_nums):
             def body(carry, xs):
-                p, v = carry
+                p, v, conf_acc = carry
                 idx, bs, step = xs
                 key = jax.random.fold_in(base_key, step)
-                p, v, metrics = self._step_core(
+                p, v, (loss, n_err, conf) = self._step_core(
                     p, v, hypers, dataset, targets, idx, bs, key)
-                return (p, v), metrics
+                # confusion SUMS on device in the carry: stacking K
+                # (C,C) matrices and pulling them per step was the real-
+                # training bottleneck on slow links (28MB/segment for the
+                # 1000-class head); the Decision only ever accumulates
+                return (p, v, conf_acc + conf), (loss, n_err)
 
-            (p, v), ms = jax.lax.scan(
-                body, (params, velocities), (idx_mat, bs_vec, step_nums))
-            return p, v, ms
+            (p, v, conf_sum), ms = jax.lax.scan(
+                body, (params, velocities, jnp.zeros((nc, nc), jnp.int32)),
+                (idx_mat, bs_vec, step_nums))
+            return p, v, ms, conf_sum
 
         return jax.jit(chunk, donate_argnums=(0, 1))
 
@@ -329,18 +348,25 @@ class FusedTrainer:
         metrics come back stacked and are fed to the Decision in order."""
         import jax
 
+        import jax.numpy as jnp
+
+        nc = (self.forwards[-1].output_samples_number
+              if self.loss_kind == "softmax" and self.compute_confusion
+              else 1)
+
         @jax.jit
         def chunk(params, dataset, targets, idx_mat, bs_vec):
-            def body(carry, xs):
+            def body(conf_acc, xs):
                 idx, bs = xs
                 data = jax.numpy.take(dataset, idx, axis=0)
                 tgt = jax.numpy.take(targets, idx, axis=0)
-                _, metrics = self.loss_and_metrics(
+                _, (loss, n_err, conf) = self.loss_and_metrics(
                     params, data, tgt, bs, self._key0, train=False)
-                return carry, metrics
+                return conf_acc + conf, (loss, n_err)
 
-            _, ms = jax.lax.scan(body, 0, (idx_mat, bs_vec))
-            return ms
+            conf_sum, ms = jax.lax.scan(
+                body, jnp.zeros((nc, nc), jnp.int32), (idx_mat, bs_vec))
+            return ms, conf_sum
 
         return chunk
 
@@ -440,7 +466,11 @@ class FusedTrainer:
             decision.minibatch_loss = float(loss)
             if hasattr(decision, "minibatch_n_err"):
                 decision.minibatch_n_err = int(n_err)
-                decision.confusion_matrix = np.asarray(conf)
+                # None = already accounted via a device-side running sum
+                # (DecisionBase skips None); transferred at segment/epoch
+                # granularity, not per minibatch
+                decision.confusion_matrix = (None if conf is None
+                                             else np.asarray(conf))
             decision.run()
 
         seen_kinds = set()
@@ -488,23 +518,32 @@ class FusedTrainer:
         loader.indices_only = True
         pending = None                  # an advanced-but-unprocessed mb
         inflight = None                 # (seg, kind, device results, t0)
+        epoch_conf = None               # device-side confusion running sum
 
         def flush():
             """Sync + feed the in-flight TRAIN segment's metrics.  Runs
             AFTER the next segment is dispatched, so the host round-trip
             overlaps device compute (one-deep pipeline); non-tail TRAIN
             feeds cannot flip `complete`/`gd_skip`, so deferring them one
-            segment changes no control flow — tails/eval flush first."""
-            nonlocal inflight
+            segment changes no control flow — tails/eval flush first.
+            Confusion stays on device (``epoch_conf``), transferred once
+            at the epoch tail."""
+            nonlocal inflight, epoch_conf
             if inflight is None:
                 return
             seg, kind, res, t0 = inflight
             inflight = None
             if kind == "single":
-                stacked = [res]
+                loss, n_err, conf = res
+                epoch_conf = conf if epoch_conf is None \
+                    else epoch_conf + conf
+                stacked = [(loss, n_err, None)]
             else:
-                losses, n_errs, confs = (np.asarray(m) for m in res)
-                stacked = [(losses[i], n_errs[i], confs[i])
+                ms, conf_sum = res
+                epoch_conf = conf_sum if epoch_conf is None \
+                    else epoch_conf + conf_sum
+                losses, n_errs = (np.asarray(m) for m in ms)
+                stacked = [(losses[i], n_errs[i], None)
                            for i in range(len(seg))]
             for s, m in zip(seg, stacked):
                 feed_decision(s, m)
@@ -547,11 +586,12 @@ class FusedTrainer:
                         steps = np.arange(self.steps_done,
                                           self.steps_done + len(seg),
                                           dtype=np.int32)
-                        params, velocities, ms = self._train_scan(
-                            params, velocities, self.hypers(), dataset,
-                            targets, idx_mat, bs_vec,
-                            put(gen.jax_base_key()), put(steps))
-                        result = ("scan", ms)
+                        params, velocities, ms, conf_sum = \
+                            self._train_scan(
+                                params, velocities, self.hypers(), dataset,
+                                targets, idx_mat, bs_vec,
+                                put(gen.jax_base_key()), put(steps))
+                        result = ("scan", (ms, conf_sum))
                     self.steps_done += len(seg)
                     flush()             # previous segment, AFTER dispatch
                     inflight = (seg, result[0], result[1], t_iter)
@@ -559,13 +599,17 @@ class FusedTrainer:
                     flush()
                     # epoch tail: metrics first, Decision rules, and the
                     # update applies only if gd_skip stayed open
-                    # (unit-path parity)
+                    # (unit-path parity).  The epoch's device-side
+                    # confusion sum rides along in this one transfer.
                     idx = put(mb["idx"])
                     bs = np.int32(mb["size"])
                     key = prng.get("fused_trainer").jax_key(self.steps_done)
-                    metrics = self._eval_step(params, dataset, targets,
-                                              idx, bs, key, True)
-                    feed_decision(mb, metrics)
+                    loss, n_err, conf = self._eval_step(
+                        params, dataset, targets, idx, bs, key, True)
+                    if epoch_conf is not None:
+                        conf = epoch_conf + conf
+                        epoch_conf = None
+                    feed_decision(mb, (loss, n_err, conf))
                     if not bool(decision.gd_skip):
                         params, velocities, _ = self._train_step(
                             params, velocities, self.hypers(), dataset,
@@ -594,11 +638,12 @@ class FusedTrainer:
                         idx_mat = put(np.stack([s["idx"] for s in seg]))
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
-                        ms = self._eval_scan(params, dataset, targets,
-                                             idx_mat, bs_vec)
-                        losses, n_errs, confs = (np.asarray(m)
-                                                 for m in ms)
-                        stacked = [(losses[i], n_errs[i], confs[i])
+                        ms, conf_sum = self._eval_scan(
+                            params, dataset, targets, idx_mat, bs_vec)
+                        losses, n_errs = (np.asarray(m) for m in ms)
+                        # segment confusion fed once, with the first step
+                        stacked = [(losses[i], n_errs[i],
+                                    conf_sum if i == 0 else None)
                                    for i in range(len(seg))]
                     for s, m in zip(seg, stacked):
                         feed_decision(s, m)
