@@ -254,3 +254,83 @@ def test_fused_remat_matches(tmp_path):
         np.testing.assert_allclose(np.array(f.weights.map_read()),
                                    wf_[f.name], rtol=1e-4, atol=1e-6,
                                    err_msg=f.name)
+
+
+def test_fused_eval_segments_respect_class_boundary(tmp_path):
+    """With both TEST and VALID sets, per-class confusion must match the
+    unit path exactly — eval scan segments may not span the class
+    boundary (their summed confusion is booked to the first class)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    def build():
+        prng.reset(1013)
+        root.mnist.loader.n_train = 300
+        root.mnist.loader.n_valid = 120
+        root.mnist.loader.n_test = 120
+        root.mnist.loader.minibatch_size = 60
+        root.mnist.decision.max_epochs = 2
+        root.common.dirs.snapshots = str(tmp_path)
+        wf = mnist.MnistWorkflow()
+        wf.initialize(device=None)
+        return wf
+
+    try:
+        wfu = build()
+        wfu.run()
+        wff = build()
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        FusedTrainer(wff).run()
+        for klass in (0, 1, 2):
+            cu = np.asarray(wfu.decision.epoch_metrics[klass]["confusion"])
+            cf = np.asarray(wff.decision.epoch_metrics[klass]["confusion"])
+            np.testing.assert_array_equal(cu, cf, err_msg=f"class {klass}")
+            assert cf.sum() > 0
+    finally:
+        root.mnist.loader.n_test = 0
+
+
+def test_fused_train_only_epoch_hook_once_per_epoch(tmp_path):
+    """Train-only workflows (no TEST/VALID): the epoch-end hook must fire
+    exactly once per epoch — a stale epoch_ended flag used to re-run it
+    after the next epoch's first pipelined segment."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 0
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 3
+    root.common.dirs.snapshots = str(tmp_path)
+    try:
+        wf = mnist.MnistWorkflow()
+        wf.initialize(device=None)
+        calls = []
+        wf.snapshotter.run = lambda: calls.append(1)
+        wf.snapshotter.gate_skip.set(False)
+        FusedTrainer(wf).run()
+        assert bool(wf.decision.complete)
+        assert len(calls) == 3, calls       # once per epoch, not more
+    finally:
+        root.mnist.loader.n_valid = 60
+
+
+def test_fused_wall_time_not_double_counted(tmp_path):
+    """Pipelined accounting must charge non-overlapping intervals:
+    stats wall_s may not exceed true elapsed time."""
+    import time as _t
+
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = fresh_mnist()
+    trainer = FusedTrainer(wf)
+    t0 = _t.perf_counter()
+    trainer.run()
+    elapsed = _t.perf_counter() - t0
+    assert trainer.stats["wall_s"] <= elapsed * 1.02 + 0.01, \
+        (trainer.stats["wall_s"], elapsed)

@@ -267,6 +267,15 @@ def test_workflow_digest_semantics(tmp_path):
     b.gds[0].learning_rate = old_lr
     assert workflow_digest(a) == workflow_digest(b)
 
+    # STRUCTURAL change without any weight-shape change must also
+    # mismatch: peers then compute different functions (review finding —
+    # the first digest only covered weighted layers' shapes/hypers)
+    old_wt = b.forwards[0].weights_transposed
+    b.forwards[0].weights_transposed = not old_wt
+    assert workflow_digest(a) != workflow_digest(b)
+    b.forwards[0].weights_transposed = old_wt
+    assert workflow_digest(a) == workflow_digest(b)
+
     w = a.forwards[0].weights
     import numpy as np_
 

@@ -427,3 +427,26 @@ def test_snapshotter_orbax_format_roundtrip(tmp_path):
         np.array(wf2.forwards[0].weights.map_read()), w0)
     wf2.run()                           # continues training
     assert bool(wf2.decision.complete)
+
+
+def test_orbax_meta_roundtrips_numpy_state(tmp_path):
+    """Normalizer-style numpy arrays in the metadata sidecar round-trip
+    exactly (review finding: default=repr silently corrupted them)."""
+    from znicz_tpu.snapshotter import _load_orbax, _save_orbax
+
+    mean = np.linspace(0, 1, 2000).astype(np.float32)   # > print threshold
+    snap = {"units": {"f": {"w": np.ones((2, 2), np.float32)}},
+            "velocities": {},
+            "loader": {"epoch_number": 2,
+                       "normalizer": {"kind": "mean_disp", "mean": mean,
+                                      "disp": mean * 2 + 1}},
+            "decision": {"best_metric": 0.5, "best_epoch": 1, "fails": 0},
+            "prng": {}, "epoch": 2, "metric": 0.5}
+    path = str(tmp_path / "s.orbax")
+    _save_orbax(path, snap)
+    back = _load_orbax(path)
+    got = back["loader"]["normalizer"]
+    assert got["kind"] == "mean_disp"
+    np.testing.assert_array_equal(got["mean"], mean)
+    np.testing.assert_array_equal(got["disp"], mean * 2 + 1)
+    assert back["loader"]["epoch_number"] == 2

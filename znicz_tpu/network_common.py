@@ -21,19 +21,43 @@ from typing import Optional
 PROTOCOL_VERSION = 1
 
 
+#: structural attributes that define a unit's computation (beyond its
+#: param shapes): conv/pool geometry, dropout rate, LRN constants, …
+_UNIT_STRUCT_ATTRS = ("kx", "ky", "sliding", "padding", "n_kernels",
+                      "dropout_ratio", "alpha", "beta", "n", "k",
+                      "output_sample_shape", "heads", "head_dim", "causal",
+                      "weights_transposed")
+
+
+def _unit_fingerprint(f) -> list:
+    """The unit's computational identity: class, IO shapes, structural
+    attributes, and (for weighted units) param shapes."""
+    attrs = []
+    for a in _UNIT_STRUCT_ATTRS:
+        v = getattr(f, a, None)
+        if v is not None:
+            attrs.append([a, list(v) if isinstance(v, (tuple, list))
+                          else v])
+    shapes = sorted((k, list(arr.shape)) for k, arr in f.params().items()) \
+        if f.has_weights else []
+    io = [list(f.input.shape) if getattr(f, "input", None) is not None
+          else None,
+          list(f.output.shape) if getattr(f, "output", None) is not None
+          and f.output.mem is not None else None]
+    return [f.name, type(f).__name__, io, attrs, shapes]
+
+
 def workflow_digest(workflow) -> str:
-    """Stable short digest of the BUILT trainable graph — the actual
-    weight-delta compatibility contract: layer names, unit classes, param
-    shapes, and each GD twin's hyperparameters.  Deliberately NOT a digest
-    of the global config tree: that tree also carries host-local paths and
+    """Stable short digest of the BUILT graph — the compatibility contract
+    for shipping weights/deltas between peers: every forward unit's class,
+    IO shapes, structural attributes (conv/pool geometry, dropout rate,
+    LRN constants) and param shapes, plus each GD twin's hyperparameters.
+    A mismatch anywhere means the two peers compute different functions,
+    so their gradients must not be mixed.  Deliberately NOT a digest of
+    the global config tree: that tree also carries host-local paths and
     the defaults of whichever sample modules happen to be imported, which
     made legitimately-identical deployments mismatch."""
-    desc = []
-    for f in workflow.forwards:
-        if f.has_weights:
-            desc.append([f.name, type(f).__name__,
-                         sorted((k, list(a.shape))
-                                for k, a in f.params().items())])
+    desc = [_unit_fingerprint(f) for f in workflow.forwards]
     for gd in getattr(workflow, "gds", []) or []:
         if gd.forward.has_weights:
             desc.append([gd.forward.name, type(gd).__name__,

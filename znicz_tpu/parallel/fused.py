@@ -474,8 +474,17 @@ class FusedTrainer:
             decision.run()
 
         seen_kinds = set()
+        last_end = [None]       # end of the last accounted interval
 
-        def account(n_steps, n_images, dt, is_train, kind="train"):
+        def account(n_steps, n_images, t0, is_train, kind="train"):
+            # charge [max(t0, last interval end), now]: with the pipeline,
+            # segment N's flush happens during iteration N+1, whose own
+            # t0 predates the flush — naive (now - t0) intervals overlap
+            # and double-count wall time
+            now = _time.perf_counter()
+            start = t0 if last_end[0] is None else max(t0, last_end[0])
+            dt = max(now - start, 1e-9)
+            last_end[0] = now
             stats["wall_s"] += dt
             stats["last_step_ms"] = round(dt / n_steps * 1e3, 3)
             if is_train:
@@ -547,8 +556,7 @@ class FusedTrainer:
                            for i in range(len(seg))]
             for s, m in zip(seg, stacked):
                 feed_decision(s, m)
-            account(len(seg), sum(s["size"] for s in seg),
-                    _time.perf_counter() - t0, True,
+            account(len(seg), sum(s["size"] for s in seg), t0, True,
                     kind=f"train_{kind}_{len(seg)}")
 
         try:
@@ -615,17 +623,19 @@ class FusedTrainer:
                             params, velocities, self.hypers(), dataset,
                             targets, idx, bs, key)
                     self.steps_done += 1
-                    account(1, mb["size"], _time.perf_counter() - t_iter,
-                            True, kind="tail")
+                    account(1, mb["size"], t_iter, True, kind="tail")
                 else:
                     flush()
                     # TEST/VALID: params are frozen, so consecutive eval
-                    # minibatches scan as a pure map in one dispatch
+                    # minibatches of the SAME class scan as a pure map in
+                    # one dispatch (segments must not span the TEST|VALID
+                    # boundary — the segment's summed confusion is booked
+                    # to the first minibatch's class)
                     seg = [mb]
                     max_seg = self.scan_chunk if self._eval_scan else 1
                     while len(seg) < max_seg:
                         nxt = self._advance()
-                        if nxt["class"] != TRAIN:
+                        if nxt["class"] == mb["class"]:
                             seg.append(nxt)
                         else:
                             pending = nxt
@@ -647,10 +657,16 @@ class FusedTrainer:
                                    for i in range(len(seg))]
                     for s, m in zip(seg, stacked):
                         feed_decision(s, m)
-                    account(len(seg), 0, _time.perf_counter() - t_iter,
-                            False, kind=f"eval_{len(seg)}")
+                    account(len(seg), 0, t_iter, False,
+                            kind=f"eval_{len(seg)}")
                 if bool(decision.epoch_ended):
                     epoch_end_hook()
+                    # consume the flag: with the pipeline, the next loop
+                    # iteration may not feed the decision before this
+                    # check runs again, and a stale True would re-save
+                    # the 'best' snapshot with weights already advanced
+                    # past the epoch boundary
+                    decision.epoch_ended.set(False)
             flush()
             self.writeback(params, velocities)
         finally:

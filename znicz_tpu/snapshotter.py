@@ -178,6 +178,33 @@ def _orbax_checkpointer():
     return _ORBAX_CKPTR
 
 
+def _jsonify(obj):
+    """Faithful JSON encoding for the metadata sidecar — numpy arrays (e.g.
+    loader-normalizer state) round-trip exactly instead of degrading to a
+    (possibly truncated) repr string."""
+    if isinstance(obj, np.ndarray):
+        return {"__ndarray__": obj.tolist(), "__dtype__": str(obj.dtype)}
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {str(k): _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return obj
+
+
+def _dejsonify(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {"__ndarray__", "__dtype__"}:
+            return np.asarray(obj["__ndarray__"], dtype=obj["__dtype__"])
+        return {k: _dejsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dejsonify(v) for v in obj]
+    return obj
+
+
 def _save_orbax(path: str, snap: Dict) -> None:
     """TPU-native checkpoint layout: the weight/velocity pytrees go through
     orbax/tensorstore (sharded-array-capable, no pickled code), everything
@@ -194,7 +221,7 @@ def _save_orbax(path: str, snap: Dict) -> None:
     meta = {k: v for k, v in snap.items()
             if k not in ("units", "velocities")}
     with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, default=repr)      # inf/nan: python-json style
+        json.dump(_jsonify(meta), f, default=repr)  # inf/nan: py-json style
 
 
 def _load_orbax(path: str) -> Dict:
@@ -203,6 +230,6 @@ def _load_orbax(path: str) -> Dict:
     arrays = _orbax_checkpointer().restore(
         os.path.join(os.path.abspath(path), "arrays"))
     with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+        meta = _dejsonify(json.load(f))
     return {**meta, "units": arrays["units"],
             "velocities": arrays["velocities"]}
