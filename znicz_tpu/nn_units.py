@@ -166,6 +166,12 @@ class GradientDescentBase(Unit, Distributable):
       weights_decay (0.0), weights_decay_bias (0.0), l1_vs_l2 (0.0 = pure L2),
       gradient_moment (0.0), gradient_moment_bias (= gradient_moment),
       gradient_clip (0 = off; max-abs clip of raw gradients).
+
+    Update rule: SGD with momentum + L1/L2 + clip — the policy every
+    BASELINE config uses.  SURVEY §2.3 flags possible adagrad/adadelta
+    accumulator variants in the reference's weight-update kernels as
+    "verify against the mount"; the mount is empty, so those remain an
+    explicit, documented drop until a reference to verify against exists.
     """
 
     def __init__(self, workflow=None, name=None, forward: ForwardBase = None,
