@@ -160,6 +160,11 @@ def main(legacy: bool = False) -> None:
     dataset = wf.loader.original_data.devmem
     targets = wf.loader.original_labels.devmem
     hypers = trainer.hypers()
+    # the scan takes per-step hypers rows (LR-schedule support);
+    # the bench uses constant hypers -> tile
+    hypers_mat = {name: np.tile(np.asarray(h, np.float32),
+                               (STEPS, 1))
+                  for name, h in hypers.items()}
 
     wf.loader.indices_only = True     # the scan gathers on device itself
 
@@ -209,14 +214,14 @@ def main(legacy: bool = False) -> None:
     flops_step = analytic_train_flops(wf, BATCH)
     # warmup at the SAME scan length so the timed call reuses the compile
     idx_mat, bs_vec = draw_minibatches(STEPS)
-    params, vels, ms = scan(params, vels, hypers, dataset, targets,
+    params, vels, ms, _conf = scan(params, vels, hypers_mat, dataset, targets,
                             idx_mat[:, :], bs_vec, base_key, steps_from(0))
     materialize(params, ms[0])
     warmup_losses = [float(l) for l in np.asarray(ms[0])]
     # XLA's cost model counts the scan (while-loop) body ONCE, so the
     # lowered scan's flops ARE the per-step flops
     xla_flops_step = xla_flops(
-        scan, params, vels, hypers, dataset, targets, idx_mat, bs_vec,
+        scan, params, vels, hypers_mat, dataset, targets, idx_mat, bs_vec,
         base_key, steps_from(0))
 
     # three independently-timed windows, each restarted from the SAME
@@ -236,7 +241,7 @@ def main(legacy: bool = False) -> None:
         p = jax.tree_util.tree_map(jnp.copy, base_params)
         v = jax.tree_util.tree_map(jnp.copy, base_vels)
         t0 = time.perf_counter()        # ~1ms of copies may drain in-queue
-        p, v, ms = scan(p, v, hypers, dataset, targets,
+        p, v, ms, _conf = scan(p, v, hypers_mat, dataset, targets,
                         idx_mat, bs_vec, base_key, steps_from(STEPS))
         materialize(p, ms[0])
         runs.append(time.perf_counter() - t0)
@@ -259,7 +264,7 @@ def main(legacy: bool = False) -> None:
     # post-timing profiler trace (never perturbs the measurement above)
     try:
         with jax.profiler.trace(PROFILE_DIR):
-            params, vels, ms = scan(params, vels, hypers, dataset, targets,
+            params, vels, ms, _conf = scan(params, vels, hypers_mat, dataset, targets,
                                     idx_mat, bs_vec, base_key,
                                     steps_from(3000))
             materialize(params, ms[0])

@@ -334,3 +334,49 @@ def test_fused_wall_time_not_double_counted(tmp_path):
     elapsed = _t.perf_counter() - t0
     assert trainer.stats["wall_s"] <= elapsed * 1.02 + 0.01, \
         (trainer.stats["wall_s"], elapsed)
+
+
+def test_fused_lr_schedule_matches_unit_path(tmp_path):
+    """An LR schedule wired by StandardWorkflow (lr_adjust_config) must
+    drive the fused path exactly like the graph engine (the fast path
+    used to ignore LearningRateAdjust silently) — per-step hypers ride
+    the scan as xs."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples.mnist import MnistLoader
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    def with_schedule():
+        prng.reset(1013)
+        root.mnist.loader.n_train = 300
+        root.mnist.loader.n_valid = 60
+        root.mnist.loader.n_test = 0
+        root.mnist.loader.minibatch_size = 60
+        root.common.dirs.snapshots = str(tmp_path)
+        gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+        wf = StandardWorkflow(
+            name="MnistStdLR",
+            loader=MnistLoader(name="loader", minibatch_size=60),
+            layers=[{"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 100}, "<-": dict(gd)},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 10}, "<-": dict(gd)}],
+            loss_function="softmax",
+            decision_config={"max_epochs": 3},
+            lr_adjust_config={"policy": "exp", "gamma": 0.9})
+        wf.initialize(device=None)
+        return wf
+
+    lu, wu = run_unit(with_schedule())
+    wff = with_schedule()
+    lf, wf_ = run_fused(wff)
+    assert len(lu) == len(lf) == 3
+    np.testing.assert_allclose(lu, lf, rtol=1e-4)
+    for name in wu:
+        np.testing.assert_allclose(wu[name], wf_[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+    # the schedule really advanced: 3 epochs x 5 train steps, minus the
+    # final tail (gd_skip gates both the update and the adjust once
+    # `complete` flips — identical in both engines)
+    assert wff.lr_adjust.iteration == 14
+    np.testing.assert_allclose(wff.gds[0].learning_rate,
+                               0.1 * 0.9 ** 13, rtol=1e-6)

@@ -80,6 +80,15 @@ class FusedTrainer:
                         f"fused trainer does not support tied weights "
                         f"({f.name}.{k} shares {seen[id(arr)]})")
                 seen[id(arr)] = f"{f.name}.{k}"
+        from znicz_tpu.lr_adjust import LearningRateAdjust
+
+        #: a user-wired LearningRateAdjust unit advances once per TRAIN
+        #: step here too (the unit graph runs it per lap, gated like the
+        #: gds); scans take per-step hypers as xs so LR schedules apply
+        #: with per-step granularity, exactly as in the unit path
+        self._lr_adjust = next(
+            (u for u in workflow.units
+             if isinstance(u, LearningRateAdjust)), None)
         self._train_step = None
         self._train_scan = None
         self._eval_step = None
@@ -321,11 +330,11 @@ class FusedTrainer:
               if self.loss_kind == "softmax" and self.compute_confusion
               else 1)
 
-        def chunk(params, velocities, hypers, dataset, targets, idx_mat,
-                  bs_vec, base_key, step_nums):
+        def chunk(params, velocities, hypers_mat, dataset, targets,
+                  idx_mat, bs_vec, base_key, step_nums):
             def body(carry, xs):
                 p, v, conf_acc = carry
-                idx, bs, step = xs
+                idx, bs, step, hypers = xs
                 key = jax.random.fold_in(base_key, step)
                 p, v, (loss, n_err, conf) = self._step_core(
                     p, v, hypers, dataset, targets, idx, bs, key)
@@ -337,7 +346,7 @@ class FusedTrainer:
 
             (p, v, conf_sum), ms = jax.lax.scan(
                 body, (params, velocities, jnp.zeros((nc, nc), jnp.int32)),
-                (idx_mat, bs_vec, step_nums))
+                (idx_mat, bs_vec, step_nums, hypers_mat))
             return p, v, ms, conf_sum
 
         return jax.jit(chunk, donate_argnums=(0, 1))
@@ -520,6 +529,26 @@ class FusedTrainer:
 
             return jax.device_put(x, repl)
 
+        def advance_lr():
+            if self._lr_adjust is not None:
+                self._lr_adjust.run()
+
+        def hypers_rows(k):
+            """Per-step hypers for a k-step scan, advancing any LR
+            schedule between steps exactly like the unit graph does."""
+            if self._lr_adjust is None:
+                row = {name: np.asarray(t, np.float32)
+                       for name, t in self.hypers().items()}
+                return {name: np.tile(r, (k, 1))
+                        for name, r in row.items()}
+            rows = []
+            for _ in range(k):
+                rows.append({name: np.asarray(t, np.float32)
+                             for name, t in self.hypers().items()})
+                advance_lr()
+            return {name: np.stack([r[name] for r in rows])
+                    for name in rows[0]}
+
         import time as _time
 
         stats = self.stats
@@ -586,6 +615,7 @@ class FusedTrainer:
                             params, velocities, self.hypers(), dataset,
                             targets, put(seg[0]["idx"]),
                             np.int32(seg[0]["size"]), key)
+                        advance_lr()
                         result = ("single", metrics)
                     else:
                         idx_mat = put(np.stack([s["idx"] for s in seg]))
@@ -596,7 +626,8 @@ class FusedTrainer:
                                           dtype=np.int32)
                         params, velocities, ms, conf_sum = \
                             self._train_scan(
-                                params, velocities, self.hypers(), dataset,
+                                params, velocities,
+                                put(hypers_rows(len(seg))), dataset,
                                 targets, idx_mat, bs_vec,
                                 put(gen.jax_base_key()), put(steps))
                         result = ("scan", (ms, conf_sum))
@@ -622,6 +653,7 @@ class FusedTrainer:
                         params, velocities, _ = self._train_step(
                             params, velocities, self.hypers(), dataset,
                             targets, idx, bs, key)
+                        advance_lr()    # adj is gated like the gds
                     self.steps_done += 1
                     account(1, mb["size"], t_iter, True, kind="tail")
                 else:

@@ -98,13 +98,19 @@ class StandardWorkflowBase(Workflow):
     def __init__(self, workflow=None, name=None, loader=None,
                  layers: List[dict] = (), loss_function: str = "softmax",
                  decision_config: Optional[dict] = None,
-                 snapshotter_config: Optional[dict] = None, **kwargs):
+                 snapshotter_config: Optional[dict] = None,
+                 lr_adjust_config: Optional[dict] = None, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         assert loader is not None, "StandardWorkflow needs a loader instance"
         self.layers_config = list(layers)
         self.loss_function = loss_function
         self.decision_config = dict(decision_config or {})
         self.snapshotter_config = dict(snapshotter_config or {})
+        #: e.g. {"policy": "exp", "gamma": 0.96} (see lr_adjust.POLICIES);
+        #: the reference's StandardWorkflow wired lr_adjust into the chain
+        #: the same way (SURVEY §2.2)
+        self.lr_adjust_config = dict(lr_adjust_config or {})
+        self.lr_adjust = None
         self.loader = loader
         self.add_unit(loader)
         self.forwards = []
@@ -198,8 +204,26 @@ class StandardWorkflowBase(Workflow):
             self.gds.append(gd)
             err_src, err_attr, tail = gd, "err_input", gd
 
+    def link_lr_adjust(self):
+        """Splice a LearningRateAdjust unit after the gd chain (one policy
+        instance per gd so per-unit iteration state can't alias), gated
+        like the gds.  No-op without ``lr_adjust_config``."""
+        if not self.lr_adjust_config or not self.gds:
+            return
+        from znicz_tpu.lr_adjust import LearningRateAdjust, make_policy
+
+        cfg = dict(self.lr_adjust_config)
+        policy_name = cfg.pop("policy")
+        self.lr_adjust = LearningRateAdjust(self, name="lr_adjust")
+        for gd in self.gds:
+            self.lr_adjust.add_gd(gd, make_policy(policy_name, **cfg))
+        self.lr_adjust.link_from(self.gds[-1])
+        self.lr_adjust.gate_skip = self.decision.gd_skip
+
     def link_loop_and_end(self):
-        self.repeater.link_from(self.gds[-1] if self.gds else self.decision)
+        loop_tail = (self.lr_adjust or (self.gds[-1] if self.gds
+                                        else self.decision))
+        self.repeater.link_from(loop_tail)
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
 
@@ -218,4 +242,5 @@ class StandardWorkflow(StandardWorkflowBase):
         self.link_decision()
         self.link_snapshotter()
         self.create_gd_units()
+        self.link_lr_adjust()
         self.link_loop_and_end()
