@@ -450,3 +450,43 @@ def test_orbax_meta_roundtrips_numpy_state(tmp_path):
     np.testing.assert_array_equal(got["mean"], mean)
     np.testing.assert_array_equal(got["disp"], mean * 2 + 1)
     assert back["loader"]["epoch_number"] == 2
+
+
+def test_publish_includes_fused_stats(tmp_path):
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.publishing import publish
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 1
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    FusedTrainer(wf).run()
+    path = publish(wf, backend="markdown", directory=str(tmp_path / "rep"))
+    text = open(path).read()
+    assert "fused_img_per_sec" in text and "fused_train_steps" in text
+
+
+def test_engine_master_mode_rejects_nondistributable(tmp_path):
+    from znicz_tpu import engine
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import kohonen
+
+    import pytest as _pytest
+
+    prng.reset(1013)
+    root.kohonen.decision.max_epochs = 1
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = kohonen.KohonenWorkflow()
+    wf.initialize(device=None)
+    root.common.engine.mode = "master"
+    try:
+        with _pytest.raises(ValueError, match="--master"):
+            engine.train(wf)
+    finally:
+        root.common.engine.mode = ""

@@ -44,6 +44,12 @@ def gather_report(workflow) -> Dict:
                         key: (float(v) if isinstance(v, (int, float))
                               else None)
                         for key, v in m.items() if key != "confusion"}
+    fused = getattr(workflow, "fused_stats", None)
+    if fused and fused.get("wall_s"):
+        rep["metrics"]["fused_img_per_sec"] = fused["img_per_sec"]
+        rep["metrics"]["fused_warm_img_per_sec"] = \
+            fused.get("warm_img_per_sec", 0.0)
+        rep["metrics"]["fused_train_steps"] = fused["train_steps"]
     plots_dir = root.common.dirs.get("plots")
     if plots_dir and os.path.isdir(plots_dir):
         rep["plots"] = sorted(f for f in os.listdir(plots_dir)
