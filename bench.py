@@ -159,12 +159,9 @@ def main(legacy: bool = False) -> None:
     vels = trainer.extract_velocities()
     dataset = wf.loader.original_data.devmem
     targets = wf.loader.original_labels.devmem
-    hypers = trainer.hypers()
     # the scan takes per-step hypers rows (LR-schedule support);
-    # the bench uses constant hypers -> tile
-    hypers_mat = {name: np.tile(np.asarray(h, np.float32),
-                               (STEPS, 1))
-                  for name, h in hypers.items()}
+    # the bench uses constant hypers
+    hypers_mat = trainer.tiled_hypers(STEPS)
 
     wf.loader.indices_only = True     # the scan gathers on device itself
 

@@ -158,24 +158,30 @@ def test_class_balanced_training_segment():
                         balance_classes=True)
     loader.initialize(device=None)
 
-    def epoch_train_labels():
+    def epoch_train_indices():
         got = []
         while True:
             loader.run()
             if loader.minibatch_class == TRAIN:
                 idx = np.array(loader.minibatch_indices.mem)
-                got.append(np.asarray(loader.original_labels.mem)[
-                    idx[:loader.minibatch_size]])
+                got.append(idx[:loader.minibatch_size].copy())
             if loader.last_minibatch:
                 return np.concatenate(got)
 
-    e1 = epoch_train_labels()
-    e2 = epoch_train_labels()
-    for ep in (e1, e2):
-        counts = np.bincount(ep, minlength=2)
+    labels_all = np.asarray(loader.original_labels.mem)
+    epochs = [epoch_train_indices() for _ in range(8)]
+    for ep in epochs:
+        counts = np.bincount(labels_all[ep], minlength=2)
         assert counts.sum() == 200
         assert abs(counts[0] - counts[1]) <= 2, counts   # balanced
-    assert not np.array_equal(e1, e2) or True            # (labels may tie)
+    # epochs genuinely reshuffle (index sequences differ)
+    assert not np.array_equal(epochs[0], epochs[1])
+    # and every epoch resamples from the FULL canonical population —
+    # resampling from the previous epoch's output would lose ~37% of
+    # distinct majority-class samples per epoch, compounding
+    majority = np.arange(20, 220)[labels_all[20:220] == 1]
+    seen_late = set(np.unique(epochs[-1])) & set(majority.tolist())
+    assert len(seen_late) > 0.55 * 100, len(seen_late)
 
     # default (no balancing) keeps the raw distribution
     from znicz_tpu.core import prng as _prng
@@ -184,5 +190,5 @@ def test_class_balanced_training_segment():
     plain = Imbalanced(name="plain", minibatch_size=20)
     plain.initialize(device=None)
     loader = plain
-    counts = np.bincount(epoch_train_labels(), minlength=2)
+    counts = np.bincount(labels_all[epoch_train_indices()], minlength=2)
     assert counts[1] == 180 and counts[0] == 20

@@ -127,25 +127,26 @@ class Loader(Unit):
         return bool(root.common.engine.get("native_shuffle", False))
 
     def _shuffle_train(self) -> None:
-        if not self.shuffle:
-            return
         start = self.class_end_offsets[VALID]
-        seg = self._shuffled_indices[start:]
-        shuffled = False
-        if self._use_native_shuffle():
-            from znicz_tpu import native
+        if self.shuffle:
+            seg = self._shuffled_indices[start:]
+            shuffled = False
+            if self._use_native_shuffle():
+                from znicz_tpu import native
 
-            if native.available():
-                if self._native_rng is None:
-                    self._native_rng = native.XorShift128P(
-                        prng.get("loader").seed)
-                seg = np.ascontiguousarray(seg)
-                self._native_rng.shuffle(seg)
-                self._shuffled_indices[start:] = seg
-                shuffled = True
-        if not shuffled:
-            perm = prng.get("loader").permutation(len(seg))
-            self._shuffled_indices[start:] = seg[perm]
+                if native.available():
+                    if self._native_rng is None:
+                        self._native_rng = native.XorShift128P(
+                            prng.get("loader").seed)
+                    seg = np.ascontiguousarray(seg)
+                    self._native_rng.shuffle(seg)
+                    self._shuffled_indices[start:] = seg
+                    shuffled = True
+            if not shuffled:
+                perm = prng.get("loader").permutation(len(seg))
+                self._shuffled_indices[start:] = seg[perm]
+        # balancing applies with or without shuffling (it places samples
+        # at randomized slots itself)
         self._balance_train(start)
 
     def train_labels(self):
@@ -159,14 +160,19 @@ class Loader(Unit):
         labels = self.train_labels()
         if labels is None:
             return
-        seg = self._shuffled_indices[start:]
-        lab = np.asarray(labels)[seg]
+        # ALWAYS resample from the canonical train population (the
+        # contiguous sample ids [start, total)) — resampling from the
+        # previous epoch's with-replacement output would lose ~37% of
+        # distinct samples per epoch, compounding
+        population = np.arange(start, self.total_samples,
+                               dtype=self._shuffled_indices.dtype)
+        lab = np.asarray(labels)[population]
         rng = prng.get("loader.balance").state
         classes = np.unique(lab)
-        n = len(seg)
-        members = {c: seg[lab == c] for c in classes}
+        n = len(population)
+        members = {c: population[lab == c] for c in classes}
         slots = rng.permutation(n)
-        out = np.empty(n, seg.dtype)
+        out = np.empty(n, population.dtype)
         i = 0
         for c, block in zip(classes,
                             np.array_split(np.arange(n), len(classes))):

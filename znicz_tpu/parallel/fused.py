@@ -140,6 +140,13 @@ class FusedTrainer:
                     gd.gradient_clip))
         return out
 
+    def tiled_hypers(self, k: int):
+        """Per-step hypers rows for a k-step scan with CONSTANT hypers —
+        the one home for the scan's hypers-xs layout (callers without an
+        LR schedule: bench, dryrun, hypers_rows' fast path)."""
+        return {name: np.tile(np.asarray(t, np.float32), (k, 1))
+                for name, t in self.hypers().items()}
+
     def writeback(self, params, velocities) -> None:
         """Push fused-step results back into the unit Arrays (snapshotter /
         plotters / unit-mode interop see the same state)."""
@@ -537,10 +544,7 @@ class FusedTrainer:
             """Per-step hypers for a k-step scan, advancing any LR
             schedule between steps exactly like the unit graph does."""
             if self._lr_adjust is None:
-                row = {name: np.asarray(t, np.float32)
-                       for name, t in self.hypers().items()}
-                return {name: np.tile(r, (k, 1))
-                        for name, r in row.items()}
+                return self.tiled_hypers(k)
             rows = []
             for _ in range(k):
                 rows.append({name: np.asarray(t, np.float32)
