@@ -161,3 +161,46 @@ def test_device_benchmark_and_aliases():
     results = bench.run()
     assert "cpu" in results
     assert bench.best() == "cpu"
+
+
+def test_standard_workflow_wires_observers(tmp_path):
+    """SURVEY §2.2 StandardWorkflow row: plotters and image_saver
+    auto-link when asked — error curve / weights tiles / confusion PNGs
+    render at epoch ends, misclassified samples get dumped."""
+    import os
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples.mnist import MnistLoader
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.common.dirs.snapshots = str(tmp_path)
+    root.common.dirs.plots = str(tmp_path / "plots")
+    root.common.dirs.image_saver = str(tmp_path / "imgs")
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="MnistObs",
+        loader=MnistLoader(name="loader", minibatch_size=60),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 50}, "<-": dict(gd)},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 10}, "<-": dict(gd)}],
+        loss_function="softmax",
+        decision_config={"max_epochs": 2},
+        image_saver_config={"limit": 8},
+        plotters=True)
+    wf.initialize(device=None)
+    wf.run()
+    assert bool(wf.decision.complete)
+    pngs = set(os.listdir(tmp_path / "plots"))
+    assert {"plot_err.png", "plot_weights.png",
+            "plot_confusion.png"} <= pngs
+    # plotters only ran at epoch ends (2 epochs -> 2 accumulated points)
+    assert len(wf.plotters[0].values) == 2
+    # misclassified dumps exist for at least one epoch
+    epochs = os.listdir(tmp_path / "imgs")
+    assert epochs and any(os.listdir(tmp_path / "imgs" / e)
+                          for e in epochs)

@@ -99,7 +99,9 @@ class StandardWorkflowBase(Workflow):
                  layers: List[dict] = (), loss_function: str = "softmax",
                  decision_config: Optional[dict] = None,
                  snapshotter_config: Optional[dict] = None,
-                 lr_adjust_config: Optional[dict] = None, **kwargs):
+                 lr_adjust_config: Optional[dict] = None,
+                 image_saver_config: Optional[dict] = None,
+                 plotters: bool = False, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         assert loader is not None, "StandardWorkflow needs a loader instance"
         self.layers_config = list(layers)
@@ -111,6 +113,18 @@ class StandardWorkflowBase(Workflow):
         #: the same way (SURVEY §2.2)
         self.lr_adjust_config = dict(lr_adjust_config or {})
         self.lr_adjust = None
+        #: SURVEY §2.2 StandardWorkflow row also auto-links plotters and
+        #: image_saver; both optional here.  image_saver_config (dict,
+        #: e.g. {"limit": 32}) dumps misclassified samples per epoch;
+        #: plotters=True wires the error curve + first-layer Weights2D +
+        #: confusion MatrixPlotter at epoch boundaries.  These are
+        #: unit-engine observers (they consume per-minibatch host data);
+        #: the fused fast path intentionally skips them — use the unit
+        #: engine when you want the debugging artifacts.
+        self.image_saver_config = image_saver_config
+        self.want_plotters = bool(plotters)
+        self.image_saver = None
+        self.plotters = []
         self.loader = loader
         self.add_unit(loader)
         self.forwards = []
@@ -220,11 +234,62 @@ class StandardWorkflowBase(Workflow):
         self.lr_adjust.link_from(self.gds[-1])
         self.lr_adjust.gate_skip = self.decision.gd_skip
 
+    def link_observers(self):
+        """Optional side units (SURVEY §2.2: "plotters/image_saver")."""
+        if self.image_saver_config is not None and self.loss_function == \
+                "softmax":
+            from znicz_tpu.image_saver import ImageSaver
+
+            sv = ImageSaver(self, name="image_saver",
+                            **self.image_saver_config)
+            sv.link_from(self.evaluator)
+            sv.link_attrs(self.loader, ("input", "minibatch_data"),
+                          ("labels", "minibatch_labels"),
+                          ("batch_size", "minibatch_size"),
+                          "epoch_number", "last_minibatch")
+            sv.link_attrs(self.forwards[-1], "output")
+            self.image_saver = sv
+        if self.want_plotters:
+            from znicz_tpu.plotting_units import (AccumulatingPlotter,
+                                                  MatrixPlotter, Weights2D)
+
+            dec = self.decision
+            err = AccumulatingPlotter(
+                self, name="plot_err", ylabel="valid err %",
+                fetch=lambda: (dec.epoch_metrics[1] or {}).get(
+                    "err_pct", 0.0))
+            plots = [err]
+            first_weighted = next(
+                (f for f in self.forwards if f.has_weights), None)
+            if first_weighted is not None:
+                plots.append(Weights2D(self, name="plot_weights",
+                                       source=first_weighted.weights))
+            if self.loss_function == "softmax":
+                import numpy as _np
+
+                plots.append(MatrixPlotter(
+                    self, name="plot_confusion",
+                    fetch=lambda: _np.asarray(
+                        (dec.epoch_metrics[1] or {}).get("confusion")
+                        if (dec.epoch_metrics[1] or {}).get("confusion")
+                        is not None else [[0]])))
+            prev = self.snapshotter
+            for p in plots:
+                p.link_from(prev)
+                p.gate_skip = ~self.decision.epoch_ended   # epoch ends only
+                prev = p
+            self.plotters = plots
+
     def link_loop_and_end(self):
         loop_tail = (self.lr_adjust or (self.gds[-1] if self.gds
                                         else self.decision))
         self.repeater.link_from(loop_tail)
         self.end_point.link_from(self.decision)
+        if self.plotters:
+            # the final epoch's plots must render before the run stops —
+            # EndPoint waits for the plot chain too (gate-skipped units
+            # still propagate control on ordinary laps)
+            self.end_point.link_from(self.plotters[-1])
         self.end_point.gate_block = ~self.decision.complete
 
 
@@ -243,4 +308,5 @@ class StandardWorkflow(StandardWorkflowBase):
         self.link_snapshotter()
         self.create_gd_units()
         self.link_lr_adjust()
+        self.link_observers()
         self.link_loop_and_end()
