@@ -11,20 +11,28 @@ Layer map (mirrors SURVEY.md §1, rebuilt for TPU):
   - ``znicz_tpu.backends``  — Device abstraction (TPU / CPU / virtual mesh).
   - ``znicz_tpu.ops``       — pure-functional jnp/lax/Pallas ops (the analogue
                               of the reference's .cl/.cu kernel trees).
-  - ``znicz_tpu.units``     — NN units: forwards (All2All*, Conv*, Pooling*,
-                              Activation*, LRN, Dropout, Kohonen, RBM, ...)
-                              and their GradientDescent* twins, Evaluators,
-                              Decision, LR scheduling.
-  - ``znicz_tpu.loader``    — Loader state machine, FullBatch/image loaders,
+  - NN unit modules (top level) — forwards (``all2all``, ``conv``,
+                              ``pooling``, ``activation``, ``lrn``,
+                              ``dropout``, ``kohonen``, ``rbm``,
+                              ``attention``, ...) and their
+                              GradientDescent* twins, ``evaluator``,
+                              ``decision``, ``lr_adjust``,
+                              ``standard_workflow``.
+  - ``znicz_tpu.loader``    — Loader state machine (shuffling, balancing),
+                              FullBatch/image/pickles/HDF5/LMDB loaders,
                               normalizers.
-  - ``znicz_tpu.engine``    — the fused trainer: compiles a Workflow's forward
-                              chain + evaluator + GD configs into ONE jitted
-                              (and mesh-sharded) train step.
-  - ``znicz_tpu.parallel``  — mesh construction, sharding rules, collectives;
-                              replaces the reference's ZeroMQ master-slave DP
-                              with SPMD psum over ICI.
+  - ``znicz_tpu.engine``    — engine selection: unit graph vs the fused
+                              SPMD fast path vs master/slave roles
+                              (launcher --fused/--master/--slave).
+  - ``znicz_tpu.parallel``  — mesh construction, sharding rules, and
+                              ``FusedTrainer`` (one jitted, mesh-sharded
+                              scan step); replaces the reference's ZeroMQ
+                              master-slave DP with SPMD psum over ICI.
+                              The async master/slave mode survives in
+                              ``server``/``client``/``network_common``.
   - ``znicz_tpu.samples``   — MNIST, CIFAR10, MnistAE, Kohonen, AlexNet
-                              workflows (BASELINE.json configs 0-4).
+                              (BASELINE.json configs 0-4) + Wine,
+                              YaleFaces, Kanji, VideoAE.
 
 Reference provenance: /root/reference was empty when this framework was
 written (see SURVEY.md §0); component parity targets come from
