@@ -204,3 +204,39 @@ def test_standard_workflow_wires_observers(tmp_path):
     epochs = os.listdir(tmp_path / "imgs")
     assert epochs and any(os.listdir(tmp_path / "imgs" / e)
                           for e in epochs)
+
+
+def test_fused_engine_runs_plotters_at_epoch_ends(tmp_path):
+    """The fast path drives epoch-granular plotters too (writeback puts
+    weights in the unit Arrays before the hook)."""
+    import os
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples.mnist import MnistLoader
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.common.dirs.snapshots = str(tmp_path)
+    root.common.dirs.plots = str(tmp_path / "plots")
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="MnistObsFused",
+        loader=MnistLoader(name="loader", minibatch_size=60),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 50}, "<-": dict(gd)},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 10}, "<-": dict(gd)}],
+        loss_function="softmax",
+        decision_config={"max_epochs": 2},
+        plotters=True)
+    wf.initialize(device=None)
+    FusedTrainer(wf).run()
+    assert bool(wf.decision.complete)
+    assert len(wf.plotters[0].values) == 2          # one point per epoch
+    pngs = set(os.listdir(tmp_path / "plots"))
+    assert {"plot_err.png", "plot_weights.png",
+            "plot_confusion.png"} <= pngs

@@ -528,6 +528,12 @@ class FusedTrainer:
                 snap.epoch_number = decision.epoch_number
                 snap.improved = decision.improved
                 snap.run()
+            # epoch-granular observers work here too: writeback just put
+            # current weights into the unit Arrays and the decision holds
+            # this epoch's metrics (ImageSaver stays unit-engine-only —
+            # it needs per-minibatch host data the fast path never pulls)
+            for plotter in getattr(wf, "plotters", None) or []:
+                plotter.run()
 
         def put(x):
             if repl is None:

@@ -117,10 +117,10 @@ class StandardWorkflowBase(Workflow):
         #: image_saver; both optional here.  image_saver_config (dict,
         #: e.g. {"limit": 32}) dumps misclassified samples per epoch;
         #: plotters=True wires the error curve + first-layer Weights2D +
-        #: confusion MatrixPlotter at epoch boundaries.  These are
-        #: unit-engine observers (they consume per-minibatch host data);
-        #: the fused fast path intentionally skips them — use the unit
-        #: engine when you want the debugging artifacts.
+        #: confusion MatrixPlotter at epoch boundaries — the fused fast
+        #: path runs these too (its epoch hook).  image_saver consumes
+        #: per-minibatch host data the fast path never pulls, so it is
+        #: unit-engine-only.
         self.image_saver_config = image_saver_config
         self.want_plotters = bool(plotters)
         self.image_saver = None
