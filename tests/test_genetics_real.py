@@ -1,0 +1,39 @@
+"""Genetics end-to-end over a REAL sample: GA tunes the MNIST learning
+rate through actual ``python -m znicz_tpu`` launcher subprocesses
+(--fused fast path, --backend cpu pinning, --fitness JSON) — the full
+reference workflow, not the fake-workflow harness."""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+
+
+def test_ga_tunes_real_mnist_lr(tmp_path):
+    from znicz_tpu.genetics import (GeneticsOptimizer, SubprocessEvaluator,
+                                    Tune)
+
+    prng.reset(1013)
+    cfg = root.ga_mnist_real
+    cfg.learning_rate = Tune(0.02, 0.005, 0.6)
+    evaluator = SubprocessEvaluator(
+        workflow="mnist",
+        overrides=["root.mnist.loader.n_train=120",
+                   "root.mnist.loader.n_valid=60",
+                   "root.mnist.loader.minibatch_size=60",
+                   "root.mnist.decision.max_epochs=2",
+                   f"root.common.dirs.snapshots={tmp_path}",
+                   "--backend", "cpu", "--fused"],
+        prefix="root.mnist", timeout=300.0)
+    opt = GeneticsOptimizer(
+        config_root=cfg, generations=2, population=3, elite=1,
+        workers=2, subprocess_evaluator=evaluator)
+    best, fitness = opt.run()
+
+    assert np.isfinite(fitness)
+    assert 0.0 <= fitness <= 1.0            # valid-err fraction
+    assert 0.005 <= best[0] <= 0.6          # tuned lr stayed in range
+    assert len(opt.history) == 2            # one entry per generation
+    # fitness is monotone non-increasing across generations (elitism)
+    assert opt.history[-1] <= opt.history[0]
+    assert opt.max_parallel >= 2            # really ran concurrently

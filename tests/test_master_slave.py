@@ -382,3 +382,14 @@ def test_launcher_master_slave_modes(tmp_path):
         root.common.engine.mode = ""
         if slave.poll() is None:
             slave.kill()
+
+
+def test_slave_clean_error_when_no_master(tmp_path):
+    """A slave pointed at a dead endpoint fails with a clear
+    ConnectionError, not a raw zmq.Again traceback."""
+    from znicz_tpu.client import Client
+
+    client = Client(_make_workflow(tmp_path / "s"),
+                    endpoint="tcp://127.0.0.1:17599")
+    with pytest.raises(ConnectionError, match="no master answered"):
+        client.run(recv_timeout=0.5)

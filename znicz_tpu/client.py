@@ -88,7 +88,13 @@ class Client:
         ctx = zmq.Context.instance()
         sock = self._connect(ctx, int(recv_timeout * 1000))
         try:
-            rep = self._rpc(sock, handshake_request(self.workflow))
+            try:
+                rep = self._rpc(sock, handshake_request(self.workflow))
+            except zmq.Again:
+                raise ConnectionError(
+                    f"no master answered at {self.endpoint} within "
+                    f"{recv_timeout:.0f}s — is the master running "
+                    f"(launcher --master)?") from None
             if not rep.get("ok"):
                 raise RuntimeError(
                     f"master refused registration: {rep.get('error')}")

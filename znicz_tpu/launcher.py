@@ -87,7 +87,10 @@ class Launcher:
                                  "fitness (genetics subprocess evaluation)")
         parser.add_argument("--list", action="store_true",
                             help="list bundled samples")
-        self.args = parser.parse_args(argv)
+        # intermixed: dotted overrides may appear before or after flags
+        # (the genetics evaluator appends chromosome overrides after the
+        # caller's flags)
+        self.args = parser.parse_intermixed_args(argv)
 
     def run(self) -> int:
         setup_logging()
@@ -102,6 +105,13 @@ class Launcher:
             args.config = None
         if args.backend:
             root.common.engine.backend = args.backend
+            if args.backend == "cpu":
+                # must happen BEFORE the first jax backend init; on hosts
+                # with the axon plugin, env vars alone cannot unpin the
+                # platform (znicz_tpu/virtdev.py)
+                from znicz_tpu.virtdev import provision_cpu_devices
+
+                provision_cpu_devices(1, verify=False)
         if args.fused:
             root.common.engine.fused = True
         if args.master is not None and args.slave is not None:
