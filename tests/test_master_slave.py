@@ -329,6 +329,10 @@ def test_web_status_shows_master_topology(tmp_path):
         assert [s["id"] for s in master["slaves"]] == ["s1"]
         assert master["slaves"][0]["last_seen_s"] >= 0
         assert snap["workflows"][0]["name"] == master_wf.name
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/") as r:
+            page = r.read().decode()
+        assert "Master" in page and "s1" in page     # topology on the page
     finally:
         status.stop()
 

@@ -108,6 +108,21 @@ class WebStatus:
                         f"<td>{'done' if w.get('complete') else 'running'}"
                         f"</td></tr>"
                         for w in snap["workflows"])
+                    master_html = ""
+                    master = snap.get("master")
+                    if master:
+                        srows = "".join(
+                            f"<tr><td>{html.escape(s['id'])}</td>"
+                            f"<td>{s['jobs']}</td>"
+                            f"<td>{s['last_seen_s']}s ago</td></tr>"
+                            for s in master["slaves"])
+                        master_html = (
+                            f"<h2>Master {html.escape(master['endpoint'])}"
+                            f"</h2><p>jobs done: {master['jobs_done']}, "
+                            f"re-queued: {master['jobs_requeued']}, stale "
+                            f"updates: {master['stale_updates']}</p>"
+                            "<table border=1><tr><th>slave</th><th>jobs"
+                            f"</th><th>last seen</th></tr>{srows}</table>")
                     body = (
                         "<html><head><meta http-equiv='refresh' content='2'>"
                         "<title>znicz-tpu status</title></head><body>"
@@ -115,6 +130,7 @@ class WebStatus:
                         "<h2>Workflows</h2><table border=1>"
                         "<tr><th>name</th><th>epoch</th><th>best</th>"
                         f"<th>state</th></tr>{rows}</table>"
+                        f"{master_html}"
                         "</body></html>").encode()
                     ctype = "text/html"
                 self.send_response(200)
