@@ -128,3 +128,50 @@ def test_client_renders_all_plotter_kinds(tmp_path):
         payload = pickle.loads(pickle.dumps(payload))  # the wire trip
         path = client.render(payload)
         assert path is not None and os.path.exists(path), p.name
+
+
+def test_fused_training_streams_plots_live(tmp_path):
+    """Full integration: a fused training run with StandardWorkflow-wired
+    plotters streams its epoch figures to a real GraphicsClient process
+    (error curve + weights + confusion over two epochs)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.graphics import GraphicsServer
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples.mnist import MnistLoader
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 120
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.common.dirs.snapshots = str(tmp_path)
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="MnistLive",
+        loader=MnistLoader(name="loader", minibatch_size=60),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 50}, "<-": dict(gd)},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 10}, "<-": dict(gd)}],
+        loss_function="softmax",
+        decision_config={"max_epochs": 2},
+        plotters=True)
+    wf.initialize(device=None)
+
+    out = tmp_path / "live"
+    server = GraphicsServer.start("tcp://127.0.0.1:*")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu.graphics", server.endpoint,
+             str(out), "--max-figures", "6", "--timeout", "120"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, text=True)
+        assert server.wait_for_subscribers(1, timeout=30)
+        FusedTrainer(wf).run()          # 2 epochs x 3 figures
+        stdout, _ = proc.communicate(timeout=120)
+    finally:
+        GraphicsServer.stop()
+    assert proc.returncode == 0
+    assert "rendered 6 figures" in stdout
+    for png in ("plot_err.png", "plot_weights.png", "plot_confusion.png"):
+        assert (out / png).exists(), png
