@@ -204,6 +204,12 @@ def test_standard_workflow_wires_observers(tmp_path):
     epochs = os.listdir(tmp_path / "imgs")
     assert epochs and any(os.listdir(tmp_path / "imgs" / e)
                           for e in epochs)
+    # the stop lap must NOT advance the loader past the end of training
+    # (EndPoint waits on the plot chain; the repeater is blocked once
+    # complete)
+    assert wf.loader.samples_served == 2 * (120 + 60)
+    # the error plotter recorded real (non-default) metric values
+    assert any(v > 0 for v in wf.plotters[0].values)
 
 
 def test_fused_engine_runs_plotters_at_epoch_ends(tmp_path):
@@ -240,3 +246,39 @@ def test_fused_engine_runs_plotters_at_epoch_ends(tmp_path):
     pngs = set(os.listdir(tmp_path / "plots"))
     assert {"plot_err.png", "plot_weights.png",
             "plot_confusion.png"} <= pngs
+
+
+def test_plotters_mse_workflow(tmp_path):
+    """plotters=True on an MSE workflow plots the validation loss (the
+    err_pct key does not exist there — review finding)."""
+    import os
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples.video_ae import VideoAELoader
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.reset(1013)
+    root.video_ae.loader.n_train = 200
+    root.video_ae.loader.n_valid = 100
+    root.video_ae.loader.minibatch_size = 100
+    root.common.dirs.snapshots = str(tmp_path)
+    root.common.dirs.plots = str(tmp_path / "plots")
+    gd = {"learning_rate": 0.05, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="VideoAEPlots",
+        loader=VideoAELoader(name="loader", targets_from_data=True,
+                             minibatch_size=100),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 24}, "<-": dict(gd)},
+                {"type": "all2all",
+                 "->": {"output_sample_shape": (16, 16)}, "<-": dict(gd)}],
+        loss_function="mse",
+        decision_config={"max_epochs": 2},
+        plotters=True)
+    wf.initialize(device=None)
+    wf.run()
+    assert bool(wf.decision.complete)
+    assert len(wf.plotters[0].values) == 2
+    assert all(v > 0 for v in wf.plotters[0].values)   # real MSE values
+    assert wf.plotters[0].ylabel == "valid loss"
+    assert os.path.exists(tmp_path / "plots" / "plot_err.png")

@@ -93,7 +93,7 @@ class Client:
             except zmq.Again:
                 raise ConnectionError(
                     f"no master answered at {self.endpoint} within "
-                    f"{recv_timeout:.0f}s — is the master running "
+                    f"{recv_timeout:g}s — is the master running "
                     f"(launcher --master)?") from None
             if not rep.get("ok"):
                 raise RuntimeError(

@@ -254,10 +254,22 @@ class StandardWorkflowBase(Workflow):
                                                   MatrixPlotter, Weights2D)
 
             dec = self.decision
+
+            def valid_metric():
+                # validation metrics when a VALID split exists, else the
+                # TRAIN epoch metrics; key depends on the decision kind
+                # (DecisionGD: err_pct, DecisionMSE: mse/loss)
+                m = dec.epoch_metrics[1] or dec.epoch_metrics[2] or {}
+                for key in ("err_pct", "mse", "loss"):
+                    if key in m:
+                        return float(m[key])
+                return 0.0
+
             err = AccumulatingPlotter(
-                self, name="plot_err", ylabel="valid err %",
-                fetch=lambda: (dec.epoch_metrics[1] or {}).get(
-                    "err_pct", 0.0))
+                self, name="plot_err",
+                ylabel=("valid err %" if self.loss_function == "softmax"
+                        else "valid loss"),
+                fetch=valid_metric)
             plots = [err]
             first_weighted = next(
                 (f for f in self.forwards if f.has_weights), None)
@@ -267,12 +279,13 @@ class StandardWorkflowBase(Workflow):
             if self.loss_function == "softmax":
                 import numpy as _np
 
-                plots.append(MatrixPlotter(
-                    self, name="plot_confusion",
-                    fetch=lambda: _np.asarray(
-                        (dec.epoch_metrics[1] or {}).get("confusion")
-                        if (dec.epoch_metrics[1] or {}).get("confusion")
-                        is not None else [[0]])))
+                def valid_confusion():
+                    conf = (dec.epoch_metrics[1] or {}).get("confusion")
+                    return _np.asarray(conf if conf is not None
+                                       else [[0]])
+
+                plots.append(MatrixPlotter(self, name="plot_confusion",
+                                           fetch=valid_confusion))
             prev = self.snapshotter
             for p in plots:
                 p.link_from(prev)
@@ -288,8 +301,12 @@ class StandardWorkflowBase(Workflow):
         if self.plotters:
             # the final epoch's plots must render before the run stops —
             # EndPoint waits for the plot chain too (gate-skipped units
-            # still propagate control on ordinary laps)
+            # still propagate control on ordinary laps).  That makes the
+            # stop lap reach the repeater before EndPoint pops, so block
+            # the repeater once training completed — the loader must not
+            # advance past the end of training
             self.end_point.link_from(self.plotters[-1])
+            self.repeater.gate_block = self.decision.complete
         self.end_point.gate_block = ~self.decision.complete
 
 
