@@ -1,0 +1,71 @@
+"""Bitrot guard: EVERY bundled sample in the launcher registry builds and
+trains end-to-end through the real CLI path (launcher.main in-process,
+tiny shapes).  A sample whose config/layers/loader drifts breaks here
+before it breaks a user."""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.launcher import SAMPLES
+
+
+#: per-sample tiny-run overrides (keep each run a few seconds on CPU)
+TINY = {
+    "mnist": ["root.mnist.loader.n_train=120",
+              "root.mnist.loader.n_valid=60",
+              "root.mnist.loader.minibatch_size=60",
+              "root.mnist.decision.max_epochs=1"],
+    "cifar": ["root.cifar.loader.n_train=100",
+              "root.cifar.loader.n_valid=50",
+              "root.cifar.loader.minibatch_size=50",
+              "root.cifar.decision.max_epochs=1"],
+    "mnist_ae": ["root.mnist_ae.loader.n_train=100",
+                 "root.mnist_ae.loader.n_valid=50",
+                 "root.mnist_ae.loader.minibatch_size=50",
+                 "root.mnist_ae.decision.max_epochs=1"],
+    "kohonen": ["root.kohonen.decision.max_epochs=1"],
+    "alexnet": ["root.alexnet.loader.minibatch_size=8",
+                "root.alexnet.loader.n_train=16",
+                "root.alexnet.loader.n_valid=8",
+                "root.alexnet.loader.n_classes=10",
+                "root.alexnet.loader.image_size=67",
+                "root.alexnet.decision.max_epochs=1"],
+    "wine": ["root.wine.decision.max_epochs=2"],
+    "yale_faces": ["root.yale_faces.loader.n_subjects=3",
+                   "root.yale_faces.loader.n_train_per_subject=4",
+                   "root.yale_faces.loader.n_valid_per_subject=2",
+                   "root.yale_faces.loader.minibatch_size=12",
+                   "root.yale_faces.decision.max_epochs=1"],
+    "kanji": ["root.kanji.loader.n_train=128",
+              "root.kanji.loader.n_valid=64",
+              "root.kanji.loader.n_classes=8",
+              "root.kanji.loader.minibatch_size=64",
+              "root.kanji.decision.max_epochs=1"],
+    "video_ae": ["root.video_ae.loader.n_train=100",
+                 "root.video_ae.loader.n_valid=50",
+                 "root.video_ae.loader.minibatch_size=50",
+                 "root.video_ae.decision.max_epochs=1"],
+}
+
+
+def test_every_registered_sample_has_tiny_overrides():
+    assert set(TINY) == set(SAMPLES), (
+        "new sample registered without a CLI smoke entry")
+
+
+@pytest.mark.parametrize("sample", SAMPLES)
+def test_sample_cli_smoke(sample, tmp_path, monkeypatch):
+    from znicz_tpu import launcher
+    from znicz_tpu.core import prng
+
+    if sample == "yale_faces":
+        root.yale_faces.loader.data_dir = str(tmp_path / "faces")
+    monkeypatch.chdir(tmp_path)
+    prng.reset(1013)
+    try:
+        rc = launcher.main([sample, *TINY[sample],
+                            f"root.common.dirs.snapshots={tmp_path}"])
+    finally:
+        if sample == "yale_faces":
+            root.yale_faces.loader.data_dir = "yale_faces_data"
+    assert rc == 0

@@ -22,7 +22,8 @@ from znicz_tpu.standard_workflow import StandardWorkflow
 
 root.alexnet.defaults({
     "loader": {"minibatch_size": 128, "n_train": 512, "n_valid": 128,
-               "n_test": 0, "n_classes": 100, "data_path": ""},
+               "n_test": 0, "n_classes": 100, "image_size": 227,
+               "data_path": ""},
     "learning_rate": 0.01,
     "gradient_moment": 0.9,
     "weights_decay": 0.0005,
@@ -41,7 +42,7 @@ class AlexNetLoader(FullBatchLoader):
         total = n_train + n_valid + n_test
         data, labels = datasets.load_or_generate(
             cfg.get("data_path") or None, datasets.tinyimages, total,
-            size=227)
+            size=int(cfg.get("image_size", 227)))
         labels = (labels % int(cfg.get("n_classes", 100))).astype(np.int32)
         self.original_data.mem = data
         self.original_labels.mem = labels
