@@ -261,11 +261,24 @@ def test_workflow_digest_semantics(tmp_path):
     b = _make_workflow(tmp_path / "b")
     assert workflow_digest(a) == workflow_digest(b)
 
+    # post-initialize mutation of the LIVE lr — what a LearningRateAdjust
+    # schedule does every step — must NOT change the digest: a slave
+    # re-registering mid-training still matches a fresh replica of the
+    # identical graph (ADVICE r3).  The digest hashes the hypers frozen
+    # at initialize.
     old_lr = b.gds[0].learning_rate
-    b.gds[0].learning_rate = old_lr * 2         # hyperparameter mismatch
-    assert workflow_digest(a) != workflow_digest(b)
-    b.gds[0].learning_rate = old_lr
+    b.gds[0].learning_rate = old_lr * 2
     assert workflow_digest(a) == workflow_digest(b)
+    b.gds[0].learning_rate = old_lr
+
+    # a genuinely differently-CONFIGURED peer still mismatches
+    old_cfg_lr = root.mnist.learning_rate
+    try:
+        root.mnist.learning_rate = old_cfg_lr * 2
+        c = _make_workflow(tmp_path / "c")
+        assert workflow_digest(a) != workflow_digest(c)
+    finally:
+        root.mnist.learning_rate = old_cfg_lr
 
     # STRUCTURAL change without any weight-shape change must also
     # mismatch: peers then compute different functions (review finding —

@@ -41,6 +41,12 @@ class Bool:
     def value(self) -> bool:
         return bool(self)
 
+    @property
+    def derived(self) -> bool:
+        """True when this Bool is a live expression over other Bools (its
+        value can flip when a source flips), False for a plain cell."""
+        return self._compute is not None
+
     def set(self, value: bool) -> None:
         """Set a concrete value (detaches any derived expression)."""
         value = bool(value)

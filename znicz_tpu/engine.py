@@ -52,11 +52,15 @@ def train(workflow) -> None:
     if wants_fused() and all(
             getattr(workflow, a, None) is not None
             for a in ("forwards", "gds", "loader", "decision")):
-        from znicz_tpu.parallel.fused import FusedTrainer
+        from znicz_tpu.parallel.fused import FusedTrainer, \
+            FusedUnsupportedError
 
         try:
             trainer = FusedTrainer(workflow)
-        except ValueError:          # e.g. tied weights -> unit path
+        except FusedUnsupportedError as exc:    # e.g. tied weights
+            workflow.warning(
+                "--fused requested but the fused path cannot run this "
+                "graph (%s); falling back to the unit engine", exc)
             workflow.run()
             return
         trainer.run()

@@ -56,6 +56,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err = 0
         self.n_classes = kwargs.get("n_classes", 0)
         self.compute_confusion = kwargs.get("compute_confusion", None)
+        #: whether the user pinned compute_confusion (vs the auto default).
+        #: The fused path accumulates confusion on device and ships it once
+        #: per epoch, so it ignores the unit path's width-based auto-off
+        #: unless the user explicitly disabled collection.
+        self.confusion_explicit = self.compute_confusion is not None
         self.confusion_matrix = Array()            # (pred, true) counts
         self.max_err_output_sum = 0.0
 

@@ -88,8 +88,24 @@ class LearningRateAdjust(Unit):
             (gd, float(gd.learning_rate), float(gd.learning_rate_bias),
              policy, bias_policy or policy))
 
-    def run(self):
+    def _apply(self, it: int) -> None:
         for gd, base, base_bias, pol, bias_pol in self._bindings:
-            gd.learning_rate = pol(base, self.iteration)
-            gd.learning_rate_bias = bias_pol(base_bias, self.iteration)
+            gd.learning_rate = pol(base, it)
+            gd.learning_rate_bias = bias_pol(base_bias, it)
+
+    def run(self):
+        self._apply(self.iteration)
         self.iteration += 1
+
+    def restore_iteration(self, iteration: int) -> None:
+        """Rewind the schedule to the state right after ``iteration`` many
+        ``run()`` calls (the fused deep pipeline's speculation rollback):
+        counter reset and the bound units' lrs rewritten accordingly —
+        back to the configured bases for iteration 0."""
+        self.iteration = int(iteration)
+        if self.iteration > 0:
+            self._apply(self.iteration - 1)
+        else:
+            for gd, base, base_bias, _pol, _bias_pol in self._bindings:
+                gd.learning_rate = base
+                gd.learning_rate_bias = base_bias

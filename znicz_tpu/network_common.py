@@ -60,12 +60,25 @@ def workflow_digest(workflow) -> str:
     desc = [_unit_fingerprint(f) for f in workflow.forwards]
     for gd in getattr(workflow, "gds", []) or []:
         if gd.forward.has_weights:
+            # the CONFIGURED hypers (frozen at initialize), not the live
+            # fields: a LearningRateAdjust schedule mutates learning_rate
+            # every step, and hashing the mutated value made a legitimate
+            # peer (slave re-registering mid-training) mismatch a fresh
+            # replica of the identical graph (ADVICE r3)
+            hypers = gd.initial_hypers
+            if hypers is None:
+                import numpy as _np
+
+                # same float32 round-trip as _hypers()/initial_hypers, so
+                # a digest computed before initialize matches one computed
+                # after on the identical graph
+                hypers = tuple(float(_np.float32(v)) for v in (
+                    gd.learning_rate, gd.learning_rate_bias,
+                    gd.weights_decay, gd.weights_decay_bias,
+                    gd.l1_vs_l2, gd.gradient_moment,
+                    gd.gradient_moment_bias, gd.gradient_clip))
             desc.append([gd.forward.name, type(gd).__name__,
-                         [round(float(v), 12) for v in (
-                             gd.learning_rate, gd.learning_rate_bias,
-                             gd.weights_decay, gd.weights_decay_bias,
-                             gd.l1_vs_l2, gd.gradient_moment,
-                             gd.gradient_moment_bias, gd.gradient_clip)]])
+                         [round(float(v), 12) for v in hypers]])
     blob = json.dumps(desc, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 

@@ -196,6 +196,12 @@ class GradientDescentBase(Unit, Distributable):
         #: first GD in the chain doesn't need err_input (reference's
         #: ``need_err_input``)
         self.need_err_input = kwargs.get("need_err_input", True)
+        #: hypers as configured, frozen at first initialize() — the values a
+        #: freshly built replica of this graph would carry.  The network
+        #: digest hashes THESE, not the live fields, so a peer whose
+        #: LearningRateAdjust schedule has advanced (slave re-registering
+        #: mid-training) still matches the master's graph (ADVICE r3).
+        self.initial_hypers = None
         self._velocities: Dict[str, Array] = {}
         self._compiled = None
 
@@ -232,6 +238,8 @@ class GradientDescentBase(Unit, Distributable):
     def initialize(self, device=None, **kwargs):
         super().initialize(device=device, **kwargs)
         assert self.forward is not None, f"{self.name}: no forward twin"
+        if self.initial_hypers is None:
+            self.initial_hypers = tuple(float(v) for v in self._hypers())
         for k, arr in self.forward.params().items():
             vel = Array(np.zeros(arr.shape, np.float32))
             vel.initialize(device)

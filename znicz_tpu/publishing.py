@@ -1,10 +1,15 @@
 """Post-training report generation (rebuild of ``veles/publishing/``).
 
 The reference rendered run reports to HTML/PDF/Confluence backends.  The
-rebuild keeps a backend registry with Markdown and HTML backends that
+rebuild keeps a backend registry with Markdown, HTML and PDF backends that
 collect everything the reference's reports contained: workflow identity,
 config snapshot, per-class epoch metrics, best validation numbers, unit
-timing table, and any rendered plot PNGs."""
+timing table, and any rendered plot PNGs.
+
+Documented drop: the **confluence** backend is intentionally not rebuilt —
+it was a thin HTTP client for a proprietary wiki API, unverifiable here
+(reference mount empty, no network) and useless without a Confluence
+server; the HTML backend output is what it would have uploaded."""
 
 from __future__ import annotations
 
