@@ -242,6 +242,25 @@ class TestArray:
         with pytest.raises(RuntimeError):
             Array().map_read()
 
+    def test_host_rewrite_cannot_corrupt_device_value(self):
+        """jax.device_put on the CPU backend ZERO-COPIES large aligned
+        numpy arrays — after unmap, in-place host writes would mutate the
+        'immutable' jax array that queued computations still read (the
+        hash-seed-dependent divergence found in r4).  map_write /
+        map_invalidate must break the aliasing first."""
+        for mapper in ("map_write", "map_invalidate"):
+            # large enough to hit the zero-copy path (~60*784 f32 did)
+            arr = Array(np.ones((64, 1024), np.float32))
+            dev = arr.devmem                  # may alias arr's host buffer
+            getattr(arr, mapper)()[...] = 7.0
+            np.testing.assert_array_equal(
+                np.asarray(dev), np.ones((64, 1024), np.float32),
+                err_msg=mapper)
+            # and the new host value still reaches the device on unmap
+            np.testing.assert_array_equal(
+                np.asarray(arr.devmem),
+                np.full((64, 1024), 7.0, np.float32), err_msg=mapper)
+
 
 def _fake_device():
     from znicz_tpu.backends import Device

@@ -43,6 +43,13 @@ class Array:
         self._devmem = None          # jax.Array or None
         self._state = _SYNCED
         self._device = None          # znicz_tpu.backends.Device
+        #: jax.device_put on the CPU backend ZERO-COPIES sufficiently large
+        #: aligned numpy arrays — the jax array aliases ``_mem``'s buffer.
+        #: Mutating the host buffer afterwards would silently corrupt the
+        #: "immutable" device value (async consumers may still be reading
+        #: it), so writes break the aliasing first (map_write/
+        #: map_invalidate).  True while ``_devmem`` may share ``_mem``.
+        self._aliased = False
         if data is not None:
             self.reset(data)
 
@@ -54,6 +61,7 @@ class Array:
             data = np.asarray(data)
         self._mem = data
         self._devmem = None
+        self._aliased = False
         self._state = _HOST_DIRTY if data is not None else _SYNCED
 
     @property
@@ -117,6 +125,7 @@ class Array:
             # zero-copy READ-ONLY view, which would make map_write hand out
             # an unwritable buffer.
             self._mem = np.array(self._devmem)
+            self._aliased = False
             self._state = _SYNCED
         if self._mem is None:
             raise RuntimeError("Array.map_read on empty Array")
@@ -124,6 +133,11 @@ class Array:
 
     def map_write(self) -> np.ndarray:
         mem = self.map_read()
+        if self._aliased:
+            # the live device value may share this buffer (zero-copy
+            # device_put) — writes must land in a fresh one
+            self._mem = mem = np.array(mem)
+            self._aliased = False
         self._state = _HOST_DIRTY
         return mem
 
@@ -134,6 +148,10 @@ class Array:
                                  np.dtype(self._devmem.dtype))
         if self._mem is None:
             raise RuntimeError("Array.map_invalidate on empty Array")
+        if self._aliased:
+            # see map_write; no copy — the caller overwrites everything
+            self._mem = np.empty_like(self._mem)
+            self._aliased = False
         self._state = _HOST_DIRTY
         return self._mem
 
@@ -150,6 +168,11 @@ class Array:
             else:
                 self._devmem = jax.device_put(self._mem)
             self._state = _SYNCED
+            # only the CPU backend zero-copies; TPU/GPU puts always copy
+            # to device memory, so marking those aliased would just force
+            # pointless host-buffer reallocation on every map_write
+            dev = next(iter(self._devmem.devices()), None)
+            self._aliased = (dev is not None and dev.platform == "cpu")
         return self._devmem
 
     @property
@@ -161,6 +184,7 @@ class Array:
     def devmem(self, value) -> None:
         """Adopt a freshly computed jax array as the authoritative value."""
         self._devmem = value
+        self._aliased = False        # computed value, not a view of _mem
         self._state = _DEV_DIRTY
 
     # -- numpy conveniences --------------------------------------------------

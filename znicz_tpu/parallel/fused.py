@@ -135,6 +135,20 @@ class FusedTrainer:
                               if root.common.engine.get("precision",
                                                         "float32")
                               == "float32" else "bfloat16")
+        #: u8 storage decodes to ``u8*scale + shift`` in-graph
+        #: (loader/streaming.py; plain f32 loaders never hit the decode)
+        self._decode_params = (np.float32(getattr(self.loader, "scale", 1.0)),
+                               np.float32(getattr(self.loader, "shift", 0.0)))
+
+    @property
+    def staging(self) -> bool:
+        """True when the dataset is host-side and every dispatch's samples
+        must be staged through host_gather + device_put (streaming regime 3
+        — loader/streaming.py).  Resolved lazily: ``device_resident`` is
+        decided by the loader's initialize."""
+        ldr = self.loader
+        return (bool(getattr(ldr, "streaming", False))
+                and not ldr.device_resident)
 
     # -- state extraction ------------------------------------------------------
 
@@ -292,13 +306,27 @@ class FusedTrainer:
                 return NamedSharding(mesh, P("model"))
         return NamedSharding(mesh, P())
 
+    def _gather_decode(self, dataset, idx):
+        """Minibatch gather + storage decode IN-GRAPH: a u8 dataset (HBM
+        u8-residency or a host-staged u8 segment — loader/streaming.py)
+        decodes ``u8*scale + shift`` fused into the gather, so HBM/link
+        traffic stays 1 byte/value and the f32 tensor only ever exists
+        inside the step."""
+        import jax.numpy as jnp
+
+        data = jnp.take(dataset, idx, axis=0)
+        if data.dtype == jnp.uint8:
+            scale, shift = self._decode_params
+            data = data.astype(jnp.float32) * scale + shift
+        return data
+
     def _step_core(self, params, velocities, hypers, dataset, targets, idx,
                    batch_size, key):
         """One pure train step (traced): gather -> fwd -> grads -> per-layer
         sgd update.  Shared by the single-step jit and the scan chunk."""
         import jax
 
-        data = jax.numpy.take(dataset, idx, axis=0)
+        data = self._gather_decode(dataset, idx)
         tgt = jax.numpy.take(targets, idx, axis=0)
         if self.mesh is not None:
             # dataset stays replicated; the gathered minibatch is what
@@ -376,7 +404,7 @@ class FusedTrainer:
 
         def body(conf_acc, xs):
             idx, bs = xs
-            data = jnp.take(dataset, idx, axis=0)
+            data = self._gather_decode(dataset, idx)
             tgt = jnp.take(targets, idx, axis=0)
             _, (loss, n_err, conf) = self.loss_and_metrics(
                 params, data, tgt, bs, self._key0, train=False)
@@ -438,7 +466,7 @@ class FusedTrainer:
 
         @partial(jax.jit, static_argnums=(6,))
         def step(params, dataset, targets, idx, batch_size, key, train):
-            data = jax.numpy.take(dataset, idx, axis=0)
+            data = self._gather_decode(dataset, idx)
             tgt = jax.numpy.take(targets, idx, axis=0)
             _, metrics = self.loss_and_metrics(
                 params, data, tgt, batch_size, key, train=train)
@@ -537,16 +565,28 @@ class FusedTrainer:
 
     def _device_state(self):
         """Params/velocities/dataset/targets as device values (mesh
-        placement applied) plus ``put`` for per-dispatch host operands."""
+        placement applied) plus ``put`` for per-dispatch host operands.
+        In staging mode dataset/targets are None — every dispatch ships
+        its own staged segment instead."""
         loader = self.loader
         params = self.extract_params()
         velocities = self.extract_velocities()
-        dataset = loader.original_data.devmem
-        if self.loss_kind == "softmax":
+        if self.staging:
+            dataset = targets = None
+        elif self.loss_kind == "softmax":
+            dataset = loader.original_data.devmem
             targets = loader.original_labels.devmem
         else:
+            dataset = loader.original_data.devmem
             targets = loader.original_targets.devmem
         if self.mesh is None:
+            if self.staging:
+                # explicit async put: the staged segment's transfer starts
+                # immediately and overlaps the in-flight dispatch, instead
+                # of riding the next jit call's implicit transfer
+                import jax
+
+                return params, velocities, None, None, jax.device_put
             return params, velocities, dataset, targets, lambda x: x
         import jax
         from znicz_tpu.parallel.mesh import replicated
@@ -560,25 +600,61 @@ class FusedTrainer:
             a, self.param_sharding(name, k, a))
             for k, a in layer.items()}
             for name, layer in velocities.items()}
-        dataset = jax.device_put(dataset, repl)
-        targets = jax.device_put(targets, repl)
+        if dataset is not None:
+            dataset = jax.device_put(dataset, repl)
+            targets = jax.device_put(targets, repl)
         return (params, velocities, dataset, targets,
                 lambda x: jax.device_put(x, repl))
+
+    def _stage_segment(self, idx_rows, put):
+        """Assemble + ship ONE dispatch's samples (streaming regime 3):
+        host-gather the segment's rows in storage dtype (u8 crosses the
+        link as u8 — 4x less traffic — and decodes in-graph), device_put
+        them asynchronously, and renumber: the scan reads the staged
+        buffer with LOCAL indices 0..K*B-1.  Returns (data, targets,
+        local_idx_matrix)."""
+        loader = self.loader
+        flat = np.concatenate([np.asarray(r, np.int32) for r in idx_rows])
+        data = put(loader.host_gather(flat))
+        if self.loss_kind == "softmax":
+            tgt = put(loader.host_gather_labels(flat))
+        else:
+            tgt = put(loader.host_gather_targets(flat))
+        local = np.arange(len(flat), dtype=np.int32).reshape(
+            len(idx_rows), len(idx_rows[0]))
+        return data, tgt, local
+
+    def _feed_ops(self, idx_rows, put, dataset, targets):
+        """(dataset, targets, idx) operands for one dispatch: the resident
+        arrays with global indices, or a freshly staged segment with local
+        ones.  ``idx_rows`` is a list of per-step index vectors; a single
+        row yields a 1-D idx (the single-step/tail calls)."""
+        if self.staging:
+            data, tgt, local = self._stage_segment(idx_rows, put)
+            idx = local[0] if len(idx_rows) == 1 else local
+            return data, tgt, put(idx)
+        idx = (np.asarray(idx_rows[0], np.int32) if len(idx_rows) == 1
+               else np.stack(idx_rows))
+        return dataset, targets, put(idx)
 
     def _advance_lr(self):
         if self._lr_adjust is not None:
             self._lr_adjust.run()
 
-    def _hypers_rows(self, k):
+    def _hypers_rows(self, k, advance_last=True):
         """Per-step hypers for a k-step scan, advancing any LR schedule
-        between steps exactly like the unit graph does."""
+        between steps exactly like the unit graph does.  ``advance_last``
+        False skips the advance after the final row — the deep path's
+        epoch tail whose update will not be adopted (the adjust is gated
+        like the gds — unit-path parity)."""
         if self._lr_adjust is None:
             return self.tiled_hypers(k)
         rows = []
-        for _ in range(k):
+        for i in range(k):
             rows.append({name: np.asarray(t, np.float32)
                          for name, t in self.hypers().items()})
-            self._advance_lr()
+            if i < k - 1 or advance_last:
+                self._advance_lr()
         return {name: np.stack([r[name] for r in rows])
                 for name in rows[0]}
 
@@ -709,16 +785,17 @@ class FusedTrainer:
                             pending = nxt
                             break
                     gen = prng.get("fused_trainer")
+                    dset, tgts, idx_op = self._feed_ops(
+                        [s["idx"] for s in seg], put, dataset, targets)
                     if len(seg) == 1:
                         key = gen.jax_key(self.steps_done)
                         params, velocities, metrics = self._train_step(
-                            params, velocities, self.hypers(), dataset,
-                            targets, put(seg[0]["idx"]),
+                            params, velocities, self.hypers(), dset,
+                            tgts, idx_op,
                             np.int32(seg[0]["size"]), key)
                         advance_lr()
                         result = ("single", metrics)
                     else:
-                        idx_mat = put(np.stack([s["idx"] for s in seg]))
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
                         steps = np.arange(self.steps_done,
@@ -727,8 +804,8 @@ class FusedTrainer:
                         params, velocities, ms, conf_sum = \
                             self._train_scan(
                                 params, velocities,
-                                put(hypers_rows(len(seg))), dataset,
-                                targets, idx_mat, bs_vec,
+                                put(hypers_rows(len(seg))), dset,
+                                tgts, idx_op, bs_vec,
                                 put(gen.jax_base_key()), put(steps))
                         result = ("scan", (ms, conf_sum))
                     self.steps_done += len(seg)
@@ -740,19 +817,20 @@ class FusedTrainer:
                     # update applies only if gd_skip stayed open
                     # (unit-path parity).  The epoch's device-side
                     # confusion sum rides along in this one transfer.
-                    idx = put(mb["idx"])
+                    dset, tgts, idx = self._feed_ops([mb["idx"]], put,
+                                                     dataset, targets)
                     bs = np.int32(mb["size"])
                     key = prng.get("fused_trainer").jax_key(self.steps_done)
                     loss, n_err, conf = self._eval_step(
-                        params, dataset, targets, idx, bs, key, True)
+                        params, dset, tgts, idx, bs, key, True)
                     if epoch_conf is not None:
                         conf = epoch_conf + conf
                         epoch_conf = None
                     feed_decision(mb, (loss, n_err, conf))
                     if not bool(decision.gd_skip):
                         params, velocities, _ = self._train_step(
-                            params, velocities, self.hypers(), dataset,
-                            targets, idx, bs, key)
+                            params, velocities, self.hypers(), dset,
+                            tgts, idx, bs, key)
                         advance_lr()    # adj is gated like the gds
                     self.steps_done += 1
                     account(1, mb["size"], t_iter, True, kind="tail")
@@ -772,16 +850,17 @@ class FusedTrainer:
                         else:
                             pending = nxt
                             break
+                    dset, tgts, idx_op = self._feed_ops(
+                        [s["idx"] for s in seg], put, dataset, targets)
                     if len(seg) == 1:
                         stacked = [self._eval_step(
-                            params, dataset, targets, put(mb["idx"]),
+                            params, dset, tgts, idx_op,
                             np.int32(mb["size"]), self._key0, False)]
                     else:
-                        idx_mat = put(np.stack([s["idx"] for s in seg]))
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
                         ms, conf_sum = self._eval_scan(
-                            params, dataset, targets, idx_mat, bs_vec)
+                            params, dset, tgts, idx_op, bs_vec)
                         losses, n_errs = (np.asarray(m) for m in ms)
                         # segment confusion fed once, with the first step
                         stacked = [(losses[i], n_errs[i],
@@ -816,6 +895,12 @@ class FusedTrainer:
         from znicz_tpu.core.mutable import Bool
 
         wf = self.workflow
+        if self.staging:
+            # host-staged streaming ships each dispatch's samples; a whole
+            # deep-pipelined epoch would stage the full epoch at once —
+            # use the segmented path, whose per-segment staging is the
+            # double buffer
+            return False
         if getattr(wf, "plotters", None):
             return False
         snap = getattr(wf, "snapshotter", None)
@@ -855,20 +940,9 @@ class FusedTrainer:
                 "epoch_number": train[-1]["epoch_number"]}
 
     def _epoch_hypers(self, k, apply_tail: bool):
-        """Hypers rows for one epoch's k+1 train steps, advancing any LR
-        schedule after every step except the tail when the tail update
-        will not be adopted (the adjust is gated like the gds — unit-path
-        parity)."""
-        if self._lr_adjust is None:
-            return self.tiled_hypers(k + 1)
-        rows = []
-        for i in range(k + 1):
-            rows.append({name: np.asarray(t, np.float32)
-                         for name, t in self.hypers().items()})
-            if i < k or apply_tail:
-                self._advance_lr()
-        return {name: np.stack([r[name] for r in rows])
-                for name in rows[0]}
+        """Hypers rows for one epoch's k+1 train steps (see
+        ``_hypers_rows`` — the one home of the row-build loop)."""
+        return self._hypers_rows(k + 1, advance_last=apply_tail)
 
     def make_epoch_fn(self, eval_layout, n_train: int):
         """The WHOLE epoch as ONE dispatch: eval scans on the incoming
