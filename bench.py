@@ -54,6 +54,27 @@ and prints one JSON line per config — the numbers recorded in BASELINE.md's
 256 resident images, FIXED minibatch indices) so the two protocols can be
 compared on the same host/build (ADVICE r2: the recorded r1 vs r2 numbers
 came from different local runs and were not comparable).
+
+``python bench.py --stream`` measures the streaming pipeline
+(loader/streaming.py, VERDICT r3 item 1) in one JSON line with three parts:
+
+  - ``value``: u8-HBM-resident throughput — the SAME scan protocol over a
+    28x-tiled u8 dataset (28,672 images) whose **float32 form (17.7 GB)
+    exceeds the chip's HBM**; it trains entirely from HBM because storage
+    stays uint8 with the decode fused into the gather.  ``pct_of_resident``
+    compares against a resident-f32 window timed in the same process —
+    the ">=90% of resident" gate.
+  - ``staged``: true host->device streaming — segments assembled on the
+    host (native row gather) and shipped per dispatch, double-buffered by
+    async dispatch.  Steady state obeys
+    ``img/s = min(compute_img_s, H2D_bytes_per_s / bytes_per_sample)``;
+    the JSON carries the MEASURED link bandwidth and the bandwidth needed
+    to be compute-bound, so the number self-explains on hosts where the
+    TPU hangs off a tunnel (this dev host: ~16 MB/s, link-bound by 100x)
+    versus a real PCIe-attached TPU host (>=8 GB/s, compute-bound).
+  - the tiled content repeats 1024 base images, so the loss-descent
+    self-check stays valid; the gather/decode path sees the full 28,672-row
+    array (physically 4.4 GB of HBM), which is what is being measured.
 """
 
 from __future__ import annotations
@@ -133,7 +154,9 @@ def xla_flops(step, *args):
         return None
 
 
-def main(legacy: bool = False) -> None:
+def _build_bench_workflow(legacy: bool = False):
+    """The bench's AlexNet workflow + FusedTrainer (shared by the headline
+    and --stream protocols)."""
     from znicz_tpu.core import prng
     from znicz_tpu.core.config import root
 
@@ -145,15 +168,44 @@ def main(legacy: bool = False) -> None:
     root.alexnet.loader.n_classes = 100 if legacy else N_CLASSES
     root.alexnet.decision.max_epochs = 10_000   # bench drives steps itself
 
-    import jax
-
-    from znicz_tpu.loader.base import TRAIN
     from znicz_tpu.parallel.fused import FusedTrainer
     from znicz_tpu.samples.alexnet import AlexNetWorkflow
 
     wf = AlexNetWorkflow()
     wf.initialize(device=None)
-    trainer = FusedTrainer(wf)
+    return wf, FusedTrainer(wf)
+
+
+def _make_materialize():
+    """Build the materialize closure: forces REAL completion by pulling
+    VALUES to the host in one fused transfer (axon's block_until_ready
+    lies — see module docstring)."""
+    import jax
+
+    @jax.jit
+    def probe(params, losses):
+        import jax.numpy as jnp
+
+        vals = [jnp.sum(losses).astype(jnp.float32)]
+        for layer in params.values():
+            for arr in layer.values():
+                vals.append(arr[(0,) * arr.ndim].astype(jnp.float32))
+        return jnp.stack(vals)
+
+    def materialize(params, losses):
+        return float(np.asarray(probe(params, losses))[0])
+
+    return materialize
+
+
+def main(legacy: bool = False) -> None:
+    from znicz_tpu.core import prng
+
+    import jax
+
+    from znicz_tpu.loader.base import TRAIN
+
+    wf, trainer = _build_bench_workflow(legacy)
     scan = trainer.make_train_scan()
     params = trainer.extract_params()
     vels = trainer.extract_velocities()
@@ -187,26 +239,7 @@ def main(legacy: bool = False) -> None:
     def steps_from(start):
         return np.arange(start, start + STEPS, dtype=np.int32)
 
-    @jax.jit
-    def _probe(params, losses):
-        """One tiny array depending on the step losses AND one element of
-        every updated param — forcing it forces the whole scan."""
-        import jax.numpy as jnp
-
-        vals = [jnp.sum(losses).astype(jnp.float32)]
-        for layer in params.values():
-            for arr in layer.values():
-                vals.append(arr[(0,) * arr.ndim].astype(jnp.float32))
-        return jnp.stack(vals)
-
-    def materialize(params, losses):
-        """Force REAL completion by pulling VALUES to the host in a single
-        transfer.  On some tunneled platforms (axon) ``block_until_ready``
-        returns before the device finishes, which silently turned r1/r2's
-        numbers into dispatch-rate measurements (>4x inflated) —
-        transferred values cannot be faked.  One fused transfer, because
-        each host round-trip costs ~100ms through the tunnel."""
-        return float(np.asarray(_probe(params, losses))[0])
+    materialize = _make_materialize()
 
     flops_step = analytic_train_flops(wf, BATCH)
     # warmup at the SAME scan length so the timed call reuses the compile
@@ -296,6 +329,197 @@ def main(legacy: bool = False) -> None:
     }))
 
 
+#: --stream protocol knobs
+N_STREAM_TILE = 28     # 28 * 1024 = 28,672 u8 images in HBM; their f32
+                       # form (28,672 * 618 KB = 17.7 GB) EXCEEDS v5e HBM
+N_HOST_TILE = 8        # host-staged dataset: 8,192 u8 images (1.27 GB RAM)
+STAGE_CHUNK = 8        # train steps per staged segment (1024 samples)
+STAGE_SEGMENTS = 3     # timed staged segments
+CHECK_LOSS = True      # False only for tiny-shape smoke runs (tests)
+
+
+def stream_main() -> None:
+    """The --stream protocol (module docstring): u8-HBM-residency at
+    beyond-f32-HBM dataset scale, plus true host->device staging with a
+    measured link-bandwidth roofline."""
+    from znicz_tpu.core import prng
+
+    import jax
+    import jax.numpy as jnp
+
+    wf, trainer = _build_bench_workflow()
+    scan = trainer.make_train_scan()
+    materialize = _make_materialize()
+    loader = wf.loader
+    dataset_f32 = loader.original_data.devmem
+    labels_dev = loader.original_labels.devmem
+    base_key = prng.get("bench").jax_base_key()
+    rng = np.random.default_rng(1013)
+
+    def draw_idx(n_steps, n_total):
+        """Epoch-shuffled minibatch index rows over [0, n_total)."""
+        out, perm = [], np.array([], np.int32)
+        while len(out) < n_steps:
+            if len(perm) < BATCH:
+                perm = rng.permutation(n_total).astype(np.int32)
+            out.append(perm[:BATCH])
+            perm = perm[BATCH:]
+        return np.stack(out)
+
+    def copies(tree):
+        return jax.tree_util.tree_map(jnp.copy, tree)
+
+    hypers = trainer.tiled_hypers(STEPS)
+    bs_vec = np.full(STEPS, BATCH, np.int32)
+    steps0 = np.arange(STEPS, dtype=np.int32)
+    # data layout is [test | valid | train] (AlexNetLoader), so TRAIN rows
+    # start after the eval split — all protocols sample the train region,
+    # exactly like main()'s loader-driven indices
+    n_eval = int(dataset_f32.shape[0]) - N_TRAIN
+
+    # ---- warmup + resident-f32 reference window (the main protocol) ------
+    params, vels = trainer.extract_params(), trainer.extract_velocities()
+    params, vels, ms, _ = scan(params, vels, hypers, dataset_f32,
+                               labels_dev,
+                               n_eval + draw_idx(STEPS, N_TRAIN),
+                               bs_vec, base_key, steps0)
+    materialize(params, ms[0])
+    loss_untrained = float(np.asarray(ms[0])[0])
+    base_params, base_vels = copies(params), copies(vels)
+    t0 = time.perf_counter()
+    p, v, ms, _ = scan(copies(base_params), copies(base_vels), hypers,
+                       dataset_f32, labels_dev,
+                       n_eval + draw_idx(STEPS, N_TRAIN),
+                       bs_vec, base_key, steps0 + STEPS)
+    materialize(p, ms[0])
+    resident_img_s = BATCH * STEPS / (time.perf_counter() - t0)
+
+    # ---- u8-resident: tiled u8 dataset whose f32 form exceeds HBM --------
+    lo = float(jnp.min(dataset_f32))
+    hi = float(jnp.max(dataset_f32))
+    scale = np.float32((hi - lo) / 255.0)
+    shift = np.float32(lo)
+    trainer._decode_params = (scale, shift)   # read at (re)trace for u8
+
+    @jax.jit
+    def quantize_tile(d, l):
+        # tile the TRAIN region only — every index into the tiled array
+        # is then a train row
+        u8 = jnp.clip(jnp.round((d[n_eval:] - shift) / scale),
+                      0, 255).astype(jnp.uint8)
+        return (jnp.tile(u8, (N_STREAM_TILE, 1, 1, 1)),
+                jnp.tile(l[n_eval:], (N_STREAM_TILE,)))
+
+    big_u8, big_labels = quantize_tile(dataset_f32, labels_dev)
+    n_big = N_TRAIN * N_STREAM_TILE
+    dataset_f32_gb = n_big * int(np.prod(dataset_f32.shape[1:])) * 4 / 2**30
+    dataset_u8_gb = dataset_f32_gb / 4
+    # compile for the u8 dtype/shape, then median-of-3 timed windows
+    p, v, ms, _ = scan(copies(base_params), copies(base_vels), hypers,
+                       big_u8, big_labels, draw_idx(STEPS, n_big), bs_vec,
+                       base_key, steps0)
+    materialize(p, ms[0])
+    runs, losses_per_run = [], []
+    for _ in range(3):
+        idx = draw_idx(STEPS, n_big)
+        p, v = copies(base_params), copies(base_vels)
+        t0 = time.perf_counter()
+        p, v, ms, _ = scan(p, v, hypers, big_u8, big_labels, idx, bs_vec,
+                           base_key, steps0 + STEPS)
+        materialize(p, ms[0])
+        runs.append(time.perf_counter() - t0)
+        losses_per_run.append([float(x) for x in np.asarray(ms[0])])
+    u8_elapsed = float(np.median(runs))
+    u8_img_s = BATCH * STEPS / u8_elapsed
+    losses = losses_per_run[int(np.argsort(runs)[1])]
+    assert all(np.isfinite(x) for x in losses), losses
+    tail = float(np.mean(losses[-10:]))
+    # CHECK_LOSS False is for tiny-shape smoke runs only (a handful of
+    # steps cannot halve the loss); the real protocol always asserts
+    assert not CHECK_LOSS or tail < 0.5 * loss_untrained, \
+        (loss_untrained, tail)
+    del big_u8, big_labels, p, v
+
+    # ---- host-staged streaming + link roofline ---------------------------
+    host_f32 = loader.original_data.mem[n_eval:]     # train rows only
+    host_u8_base = np.clip(np.round((host_f32 - shift) / scale),
+                           0, 255).astype(np.uint8)
+    host_u8 = np.tile(host_u8_base, (N_HOST_TILE, 1, 1, 1))
+    host_labels = np.tile(np.asarray(
+        loader.original_labels.mem[n_eval:], np.int32), N_HOST_TILE)
+    n_host = len(host_u8)
+    bytes_per_sample = int(np.prod(host_u8.shape[1:]))
+
+    # measured link bandwidth: one timed 64 MB u8 put, value-materialized
+    probe_buf = host_u8.reshape(-1)[:64 << 20]
+    x = jax.device_put(probe_buf)
+    float(jnp.sum(x[:: 1 << 20].astype(jnp.float32)))      # warm the path
+    t0 = time.perf_counter()
+    x = jax.device_put(probe_buf)
+    float(jnp.sum(x[:: 1 << 20].astype(jnp.float32)))
+    h2d_gbps = len(probe_buf) / (time.perf_counter() - t0) / 2**30
+
+    seg_hypers = trainer.tiled_hypers(STAGE_CHUNK)
+    seg_bs = np.full(STAGE_CHUNK, BATCH, np.int32)
+    local_idx = np.arange(STAGE_CHUNK * BATCH, dtype=np.int32).reshape(
+        STAGE_CHUNK, BATCH)
+
+    def stage(flat):
+        return (jax.device_put(np.take(host_u8, flat, axis=0)),
+                jax.device_put(np.take(host_labels, flat)))
+
+    def staged_window(p, v, n_segments, step0):
+        for s in range(n_segments):
+            flat = draw_idx(STAGE_CHUNK, n_host).reshape(-1)
+            buf, lab = stage(flat)
+            p, v, ms, _ = scan(p, v, seg_hypers, buf, lab, local_idx,
+                               seg_bs, base_key,
+                               np.arange(step0 + s * STAGE_CHUNK,
+                                         step0 + (s + 1) * STAGE_CHUNK,
+                                         dtype=np.int32))
+        materialize(p, ms[0])
+        return [float(x) for x in np.asarray(ms[0])]
+
+    p, v = copies(base_params), copies(base_vels)
+    staged_window(p, v, 1, 0)                    # compile the staged shape
+    p, v = copies(base_params), copies(base_vels)
+    t0 = time.perf_counter()
+    staged_losses = staged_window(p, v, STAGE_SEGMENTS, STAGE_CHUNK)
+    staged_s = time.perf_counter() - t0
+    staged_img_s = BATCH * STAGE_CHUNK * STAGE_SEGMENTS / staged_s
+    assert all(np.isfinite(x) for x in staged_losses), staged_losses
+
+    needed_gbps = u8_img_s * bytes_per_sample / 2**30
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "alexnet_stream_train_throughput_u8_resident",
+        "value": round(u8_img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(u8_img_s / K40_ALEXNET_IMG_S, 3),
+        "batch": BATCH, "steps": STEPS,
+        "elapsed_s_runs": [round(r, 4) for r in runs],
+        "dataset_images": n_big,
+        "dataset_f32_gb": round(dataset_f32_gb, 2),
+        "dataset_u8_gb": round(dataset_u8_gb, 2),
+        "resident_f32_img_s": round(resident_img_s, 2),
+        "pct_of_resident": round(100 * u8_img_s / resident_img_s, 1),
+        "loss_untrained": round(loss_untrained, 4),
+        "loss_last": round(losses[-1], 4),
+        "staged": {
+            "img_s": round(staged_img_s, 2),
+            "images": BATCH * STAGE_CHUNK * STAGE_SEGMENTS,
+            "host_dataset_images": n_host,
+            "bytes_per_sample_u8": bytes_per_sample,
+            "h2d_gbps_measured": round(h2d_gbps, 4),
+            "h2d_gbps_for_compute_bound": round(needed_gbps, 3),
+            "link_bound": bool(h2d_gbps < needed_gbps),
+            "roofline_img_s_at_measured_bw": round(
+                min(u8_img_s, h2d_gbps * 2**30 / bytes_per_sample), 2),
+        },
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+    }))
+
+
 def _gd_finals(decision) -> dict:
     from znicz_tpu.loader.base import TRAIN, VALID
 
@@ -345,5 +569,7 @@ def measure_samples() -> None:
 if __name__ == "__main__":
     if "--samples" in sys.argv[1:]:
         measure_samples()
+    elif "--stream" in sys.argv[1:]:
+        stream_main()
     else:
         main(legacy="--legacy" in sys.argv[1:])
