@@ -1,6 +1,8 @@
 """FusedTrainer: parity with the unit-at-a-time engine, and 8-virtual-device
 data parallelism (SURVEY.md §4: multi-device tests on CPU)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -170,6 +172,75 @@ def test_fused_snapshot_restore_continue(tmp_path):
     np.testing.assert_allclose(lf, lu, rtol=1e-4)
     for name in wf_u:
         np.testing.assert_allclose(wf_u[name], wf_f[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
+def test_cross_topology_checkpoint_resume(tmp_path):
+    """SHARDED orbax save under a {data:4, model:2} mesh, restored onto a
+    {data:8} mesh AND onto a single device (VERDICT r3 item 5): orbax
+    delivers every leaf already placed in the restoring trainer's
+    shardings, and both continued trajectories match uninterrupted
+    training."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.parallel.mesh import make_mesh
+    from znicz_tpu.samples import mnist
+
+    root.common.dirs.snapshots = str(tmp_path)
+    lo, wo = run_fused(fresh_mnist(max_epochs=4))    # uninterrupted oracle
+
+    # phase 1: train on the hybrid mesh; the snapshotter writes a SHARDED
+    # orbax checkpoint MID-RUN at the end of epoch 1 (interval=2) — the
+    # preemption-resume scenario.  (An end-of-run checkpoint could never
+    # match uninterrupted training: the stop semantics deliberately skip
+    # the final tail update.)
+    root.mnist.snapshotter.interval = 2
+    try:
+        wf = fresh_mnist(max_epochs=4)
+    finally:
+        root.mnist.snapshotter.interval = 0
+    wf.snapshotter.format = "orbax"
+    wf.snapshotter.sharded = True
+    trainer = FusedTrainer(wf, mesh=hybrid_mesh())
+    trainer.tp_threshold = 64
+    trainer.run()
+    path = str(tmp_path / "mnist_epoch_1.orbax")
+    assert os.path.isdir(path), os.listdir(tmp_path)
+    # the saved leaves really were the live sharded device arrays
+    w = wf.forwards[0].weights.devmem
+    assert len(w.sharding.device_set) == 8, w.sharding
+
+    def resume(mesh, tp_threshold=None):
+        prng.reset(1013)
+        root.mnist.decision.max_epochs = 4
+        losses = []
+        wf2 = mnist.MnistWorkflow()
+        wf2.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        wf2.initialize(device=None)
+        tr = FusedTrainer(wf2, mesh=mesh)
+        if tp_threshold is not None:
+            tr.tp_threshold = tp_threshold
+        tr.restore_sharded(path)
+        # leaves arrive placed per the RESTORING topology
+        w2 = wf2.forwards[0].weights.devmem
+        n_dev = len(w2.sharding.device_set)
+        assert n_dev == (1 if mesh is None else mesh.devices.size), \
+            w2.sharding
+        tr.run()
+        assert bool(wf2.decision.complete)
+        return losses, {f.name: np.array(f.weights.map_read())
+                        for f in wf2.forwards}
+
+    l8, w8 = resume(make_mesh(axes=("data",)))       # reshard 4x2 -> 8
+    l1, w1 = resume(None)                            # reshard -> one device
+    assert len(l8) == 2 and len(l1) == 2             # epochs 2..3 ran
+    np.testing.assert_allclose(l8, l1, rtol=1e-4)    # topology-invariant
+    np.testing.assert_allclose(l1, lo[2:], rtol=1e-3)  # matches oracle
+    for name in w1:
+        np.testing.assert_allclose(w1[name], wo[name], rtol=5e-3,
+                                   atol=5e-5, err_msg=name)
+        np.testing.assert_allclose(w8[name], w1[name], rtol=2e-3,
                                    atol=2e-5, err_msg=name)
 
 

@@ -1,0 +1,130 @@
+"""Multi-host FusedTrainer (VERDICT r3 item 4; SURVEY.md §5 comm backend):
+TWO OS processes x 4 virtual CPU devices each bring up jax.distributed,
+build ONE global {data:8} mesh, and run the REAL FusedTrainer.run() loop —
+loader state machine, decision, scans — for two epochs.  Both processes
+drive identical host state (same seeds); the global psum crosses the
+process (DCN) boundary every step.  Final losses and weights must match
+the single-process 8-device run (tests/test_fused.py's oracle property)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""\
+    import json
+    import sys
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    # verify=False: counting devices would initialize the backend, which
+    # must not happen before jax.distributed.initialize
+    provision_cpu_devices(4, verify=False)
+    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+
+    pid, n, port, snapdir = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3], sys.argv[4])
+    distributed_init(coordinator=f"127.0.0.1:{port}",
+                     num_processes=n, process_id=pid)
+    import numpy as np
+
+    import jax
+
+    assert jax.process_count() == n
+    assert len(jax.devices()) == 4 * n          # the global device set
+    assert len(jax.local_devices()) == 4
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = snapdir
+    # config mirrors tests/test_fused.fresh_mnist (the oracle build)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 2
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    mesh = make_mesh(axes=("data",))            # all 8 GLOBAL devices
+    assert mesh.shape["data"] == 4 * n
+    trainer = FusedTrainer(wf, mesh=mesh)
+    trainer.run()
+    weights = {f.name: np.asarray(f.weights.map_read()).tolist()
+               for f in wf.forwards}
+    print("RESULT " + json.dumps({"pid": pid, "losses": losses,
+                                  "weights_sum": {
+                                      k: float(np.sum(v))
+                                      for k, v in weights.items()}}),
+          flush=True)
+    np.savez(f"{snapdir}/weights_{pid}.npz",
+             **{k: np.asarray(v, np.float32) for k, v in weights.items()})
+""")
+
+
+def test_two_process_fused_training_matches_single_process(tmp_path):
+    # in-process oracle: the same workflow on this process's 8 virtual
+    # devices (the property test_fused.py already pins to single-device)
+    from tests.test_fused import fresh_mnist, run_fused
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    root.common.dirs.snapshots = str(tmp_path)
+    oracle_losses, oracle_weights = run_fused(
+        fresh_mnist(), mesh=make_mesh(axes=("data",)))
+
+    worker = tmp_path / "mh_worker.py"
+    worker.write_text(WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the pytest parent pins 8 virtual devices via XLA_FLAGS (conftest);
+    # workers must provision their OWN 4-device view
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(n), str(port),
+         str(tmp_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in range(n)]
+    results = {}
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, stderr = proc.communicate(timeout=420)
+            assert proc.returncode == 0, (pid, stderr[-3000:])
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            results[pid] = json.loads(line[len("RESULT "):])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+    # both processes observed identical trajectories (replicated metrics)
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    # and they match the single-process 8-device oracle
+    np.testing.assert_allclose(results[0]["losses"], oracle_losses,
+                               rtol=1e-4)
+    for pid in range(n):
+        with np.load(tmp_path / f"weights_{pid}.npz") as f:
+            for name, w in oracle_weights.items():
+                np.testing.assert_allclose(
+                    f[name], w, rtol=2e-3, atol=2e-5,
+                    err_msg=f"proc {pid} {name}")

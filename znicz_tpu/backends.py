@@ -27,7 +27,10 @@ class Device:
             platform = jax.default_backend()
         self.platform = platform
         self.jax_devices = jax.devices(platform)
-        self.jax_device = self.jax_devices[0]
+        # unit-at-a-time placement must be a device THIS process owns:
+        # under jax.distributed, jax.devices()[0] is global device 0,
+        # which other processes cannot address
+        self.jax_device = jax.local_devices(backend=platform)[0]
         self._mesh = None
         self._mesh_shape = mesh_shape
         self._mesh_axes = tuple(mesh_axes)

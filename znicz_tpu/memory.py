@@ -165,6 +165,13 @@ class Array:
             if self._device is not None:
                 self._devmem = jax.device_put(self._mem,
                                               self._device.jax_device)
+            elif jax.process_count() > 1:
+                # multi-controller: the bare put's default placement is
+                # GLOBAL device 0, which other processes do not own — the
+                # result would span non-addressable devices.  Host arrays
+                # belong on a local device (global_put reshards later).
+                self._devmem = jax.device_put(self._mem,
+                                              jax.local_devices()[0])
             else:
                 self._devmem = jax.device_put(self._mem)
             self._state = _SYNCED

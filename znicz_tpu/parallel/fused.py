@@ -152,8 +152,26 @@ class FusedTrainer:
 
     # -- state extraction ------------------------------------------------------
 
+    def _op_value(self, arr):
+        """An Array's value for the fused step's operands.  Multi-
+        controller meshes take the HOST buffer: global_put re-distributes
+        it shard-by-shard, and detouring through ``devmem`` would pay a
+        full extra H2D+D2H round trip on local device 0 first."""
+        if self.mesh is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                try:
+                    return arr.map_read()
+                except RuntimeError:
+                    # devmem already spans hosts (e.g. restore_sharded
+                    # placed it) — hand the global array straight through
+                    return arr.devmem
+        return arr.devmem
+
     def extract_params(self) -> Dict[str, Dict[str, object]]:
-        return {f.name: {k: a.devmem for k, a in f.params().items()}
+        return {f.name: {k: self._op_value(a)
+                         for k, a in f.params().items()}
                 for f in self.forwards if f.has_weights}
 
     def extract_velocities(self):
@@ -161,7 +179,7 @@ class FusedTrainer:
         for f in self.forwards:
             gd = self.gd_of.get(f.name)
             if gd is not None and f.has_weights:
-                out[f.name] = {k: a.devmem
+                out[f.name] = {k: self._op_value(a)
                                for k, a in gd._velocities.items()}
         return out
 
@@ -183,6 +201,48 @@ class FusedTrainer:
         LR schedule: bench, dryrun, hypers_rows' fast path)."""
         return {name: np.tile(np.asarray(t, np.float32), (k, 1))
                 for name, t in self.hypers().items()}
+
+    def restore_sharded(self, path: str):
+        """Cross-topology checkpoint resume (SURVEY §5 checkpoint row):
+        load an orbax checkpoint saved under ANY mesh topology and deliver
+        every param/velocity leaf already placed in THIS trainer's
+        shardings — orbax/tensorstore reads each target shard directly, no
+        host-gather round-trip.  Loader/decision/prng metadata is applied
+        like the standard restore.  Returns the meta dict."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        from znicz_tpu import snapshotter as snap_mod
+
+        def sds(name, k, shape):
+            probe = jax.ShapeDtypeStruct(tuple(shape), np.float32)
+            sharding = (self.param_sharding(name, k, probe)
+                        if self.mesh is not None
+                        else SingleDeviceSharding(jax.local_devices()[0]))
+            return jax.ShapeDtypeStruct(tuple(shape), np.float32,
+                                        sharding=sharding)
+
+        units = {f.name: {k: sds(f.name, k, a.shape)
+                          for k, a in f.params().items()}
+                 for f in self.forwards if f.has_weights}
+        vels = {gd.name: {k: sds(gd.forward.name, k, a.shape)
+                          for k, a in gd._velocities.items()}
+                for gd in self.workflow.gds}
+        arrays = snap_mod.load_orbax_arrays(
+            path, {"units": units, "velocities": vels})
+        for f in self.forwards:
+            if not f.has_weights:
+                continue
+            for k, a in f.params().items():
+                a.devmem = arrays["units"][f.name][k]
+            gd = self.gd_of.get(f.name)
+            if gd is not None:
+                for k, a in gd._velocities.items():
+                    a.devmem = arrays["velocities"][gd.name][k]
+        meta = snap_mod.load_orbax_meta(path)
+        snap_mod.restore(self.workflow,
+                         {**meta, "units": {}, "velocities": {}})
+        return meta
 
     def writeback(self, params, velocities) -> None:
         """Push fused-step results back into the unit Arrays (snapshotter /
@@ -574,11 +634,11 @@ class FusedTrainer:
         if self.staging:
             dataset = targets = None
         elif self.loss_kind == "softmax":
-            dataset = loader.original_data.devmem
-            targets = loader.original_labels.devmem
+            dataset = self._op_value(loader.original_data)
+            targets = self._op_value(loader.original_labels)
         else:
-            dataset = loader.original_data.devmem
-            targets = loader.original_targets.devmem
+            dataset = self._op_value(loader.original_data)
+            targets = self._op_value(loader.original_targets)
         if self.mesh is None:
             if self.staging:
                 # explicit async put: the staged segment's transfer starts
@@ -588,23 +648,22 @@ class FusedTrainer:
 
                 return params, velocities, None, None, jax.device_put
             return params, velocities, dataset, targets, lambda x: x
-        import jax
-        from znicz_tpu.parallel.mesh import replicated
+        from znicz_tpu.parallel.mesh import global_put, replicated
 
         repl = replicated(self.mesh)
-        params = {name: {k: jax.device_put(
+        params = {name: {k: global_put(
             a, self.param_sharding(name, k, a))
             for k, a in layer.items()}
             for name, layer in params.items()}
-        velocities = {name: {k: jax.device_put(
+        velocities = {name: {k: global_put(
             a, self.param_sharding(name, k, a))
             for k, a in layer.items()}
             for name, layer in velocities.items()}
         if dataset is not None:
-            dataset = jax.device_put(dataset, repl)
-            targets = jax.device_put(targets, repl)
+            dataset = global_put(dataset, repl)
+            targets = global_put(targets, repl)
         return (params, velocities, dataset, targets,
-                lambda x: jax.device_put(x, repl))
+                lambda x: global_put(x, repl))
 
     def _stage_segment(self, idx_rows, put):
         """Assemble + ship ONE dispatch's samples (streaming regime 3):

@@ -57,6 +57,27 @@ def column_sharded(mesh):
     return NamedSharding(mesh, P("model", None))
 
 
+def global_put(value, sharding):
+    """``jax.device_put`` that also works when the sharding's mesh spans
+    PROCESSES (multi-host): every process contributes the shards it owns
+    from its host-replicated ``value`` via make_array_from_callback, so no
+    cross-host device transfer is needed (jax refuses plain device_put to
+    non-addressable devices).  Single-process meshes take the plain put."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+
+    def put_leaf(v):
+        if isinstance(v, jax.Array) and v.sharding == sharding:
+            return v                     # already globally placed
+        v = np.asarray(v)
+        return jax.make_array_from_callback(v.shape, sharding,
+                                            lambda idx, v=v: v[idx])
+
+    return jax.tree_util.tree_map(put_leaf, value)
+
+
 def distributed_init(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:

@@ -29,28 +29,43 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.units import Unit
 
 
-def collect(workflow) -> Dict:
-    """Gather a snapshot dict from a workflow's units."""
+def collect(workflow, device_arrays: bool = False) -> Dict:
+    """Gather a snapshot dict from a workflow's units.  With
+    ``device_arrays`` the param/velocity leaves are the live ``devmem``
+    jax arrays — under a mesh these are SHARDED, and the orbax format
+    writes each shard from the device/process that owns it (no host
+    gather; the multi-host-safe save path)."""
     from znicz_tpu.core import prng
     from znicz_tpu.decision import DecisionBase
     from znicz_tpu.loader.base import Loader
     from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+
+    def leaf(a):
+        return a.devmem if device_arrays else np.array(a.map_read())
 
     snap: Dict = {"units": {}, "velocities": {}, "loader": {},
                   "decision": {}, "prng": {}, "time": time.time()}
     for unit in workflow:
         if isinstance(unit, ForwardBase) and unit.has_weights:
             snap["units"][unit.name] = {
-                k: np.array(a.map_read())
-                for k, a in unit.params().items()}
+                k: leaf(a) for k, a in unit.params().items()}
         elif isinstance(unit, GradientDescentBase):
             snap["velocities"][unit.name] = {
-                k: np.array(a.map_read())
-                for k, a in unit._velocities.items()}
+                k: leaf(a) for k, a in unit._velocities.items()}
         elif isinstance(unit, Loader):
             snap["loader"] = {
                 "epoch_number": unit.epoch_number,
                 "samples_served": unit.samples_served,
+                # epoch_number increments LAZILY (on the next run() after a
+                # tail); a boundary snapshot must record the tail state so
+                # the resumed loader ADVANCES to the next epoch instead of
+                # repeating the one whose updates the weights already carry
+                "last_minibatch": bool(unit.last_minibatch),
+                # each epoch's shuffle permutes the PREVIOUS order in
+                # place, so the composed order is training state: without
+                # it a resumed run reshuffles a fresh arange and the
+                # sample order diverges from uninterrupted training
+                "shuffled_indices": np.array(unit._shuffled_indices),
             }
             norm = getattr(unit, "normalizer", None)
             if norm is not None:
@@ -86,6 +101,11 @@ def restore(workflow, snap: Dict) -> None:
         elif isinstance(unit, Loader) and snap.get("loader"):
             unit.epoch_number = snap["loader"]["epoch_number"]
             unit.samples_served = snap["loader"].get("samples_served", 0)
+            unit.last_minibatch = snap["loader"].get("last_minibatch",
+                                                     False)
+            order = snap["loader"].get("shuffled_indices")
+            if order is not None:
+                unit._shuffled_indices = np.asarray(order, np.int32).copy()
             norm = getattr(unit, "normalizer", None)
             if norm is not None and "normalizer" in snap["loader"]:
                 norm.restore(snap["loader"]["normalizer"])
@@ -119,6 +139,12 @@ class Snapshotter(Unit):
         #: note); also settable via root.common.engine.snapshot_format
         self.format = kwargs.get(
             "format", root.common.engine.get("snapshot_format", "pickle"))
+        #: orbax-only: save the live (possibly mesh-sharded) device arrays
+        #: instead of host-gathered numpy — each shard written by its
+        #: owner; restore with ``FusedTrainer.restore_sharded`` reshards
+        #: onto ANY topology (root.common.engine.snapshot_sharded)
+        self.sharded = bool(kwargs.get(
+            "sharded", root.common.engine.get("snapshot_sharded", False)))
         self.destination: Optional[str] = None            # last written path
         self.improved = False                             # link from decision
         self.epoch_number = 0                             # link from decision
@@ -132,11 +158,26 @@ class Snapshotter(Unit):
         return os.path.join(self.directory, f"{self.prefix}_{tag}{ext}")
 
     def save(self, tag: str) -> str:
-        os.makedirs(self.directory, exist_ok=True)
-        snap = collect(self.workflow)
-        snap["config"] = root.to_dict()
+        import jax
+
+        multiproc = jax.process_count() > 1
         path = self.snapshot_path(tag)
+        if multiproc and self.format != "orbax":
+            # host-format saves are not collective: every process holds
+            # the same replicated state, so only process 0 writes (two
+            # writers would tear the file)
+            if jax.process_index() != 0:
+                self.destination = path
+                return path
+        os.makedirs(self.directory, exist_ok=True)
+        snap = collect(self.workflow,
+                       device_arrays=(self.format == "orbax"
+                                      and self.sharded))
+        snap["config"] = root.to_dict()
         if self.format == "orbax":
+            # collective: every process participates (each writes the
+            # array shards it owns); _save_orbax gates the dir reset and
+            # meta sidecar to process 0 with barriers
             _save_orbax(path, snap)
         else:
             opener = gzip.open if self.compression == "gz" else open
@@ -191,8 +232,18 @@ def _orbax_checkpointer():
 def _jsonify(obj):
     """Faithful JSON encoding for the metadata sidecar — numpy arrays (e.g.
     loader-normalizer state) round-trip exactly instead of degrading to a
-    (possibly truncated) repr string."""
+    (possibly truncated) repr string.  Large arrays (the loader's
+    composed shuffle order is O(dataset)) go base64-binary instead of a
+    per-element integer list — ~5 bytes/element instead of ~8 chars."""
     if isinstance(obj, np.ndarray):
+        if obj.size > 1024:
+            import base64
+
+            return {"__ndarray_b64__":
+                    base64.b64encode(np.ascontiguousarray(obj)
+                                     .tobytes()).decode("ascii"),
+                    "__dtype__": str(obj.dtype),
+                    "__shape__": list(obj.shape)}
         return {"__ndarray__": obj.tolist(), "__dtype__": str(obj.dtype)}
     if isinstance(obj, np.integer):
         return int(obj)
@@ -209,6 +260,12 @@ def _dejsonify(obj):
     if isinstance(obj, dict):
         if set(obj) == {"__ndarray__", "__dtype__"}:
             return np.asarray(obj["__ndarray__"], dtype=obj["__dtype__"])
+        if set(obj) == {"__ndarray_b64__", "__dtype__", "__shape__"}:
+            import base64
+
+            return np.frombuffer(
+                base64.b64decode(obj["__ndarray_b64__"]),
+                dtype=obj["__dtype__"]).reshape(obj["__shape__"]).copy()
         return {k: _dejsonify(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_dejsonify(v) for v in obj]
@@ -218,14 +275,27 @@ def _dejsonify(obj):
 def _save_orbax(path: str, snap: Dict) -> None:
     """TPU-native checkpoint layout: the weight/velocity pytrees go through
     orbax/tensorstore (sharded-array-capable, no pickled code), everything
-    else (loader/decision/prng/config metadata) is a JSON sidecar."""
+    else (loader/decision/prng/config metadata) is a JSON sidecar.
+    Multi-controller: COLLECTIVE — every process must call this (each
+    writes the shards it owns); only process 0 touches the directory and
+    the sidecar, with barriers around the destructive reset."""
     import json
     import shutil
 
+    import jax
+
+    multiproc = jax.process_count() > 1
     path = os.path.abspath(path)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.makedirs(path)
+    if not multiproc or jax.process_index() == 0:
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.makedirs(path)
+    if multiproc:
+        from jax.experimental import multihost_utils
+
+        # nobody starts writing into a directory another process may
+        # still be deleting
+        multihost_utils.sync_global_devices("znicz_snapshot_dir_ready")
     arrays = {"units": snap["units"], "velocities": snap["velocities"]}
     ckptr = _orbax_checkpointer()
     ckptr.save(os.path.join(path, "arrays"), arrays)
@@ -235,18 +305,32 @@ def _save_orbax(path: str, snap: Dict) -> None:
     # same tag would rmtree the directory while the commit is still
     # renaming its tmpdir inside it (ADVICE r3).
     ckptr.wait_until_finished()
-    meta = {k: v for k, v in snap.items()
-            if k not in ("units", "velocities")}
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(_jsonify(meta), f, default=repr)  # inf/nan: py-json style
+    if not multiproc or jax.process_index() == 0:
+        meta = {k: v for k, v in snap.items()
+                if k not in ("units", "velocities")}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(_jsonify(meta), f, default=repr)   # inf/nan: py-style
+
+
+def load_orbax_meta(path: str) -> Dict:
+    import json
+
+    with open(os.path.join(os.path.abspath(path), "meta.json")) as f:
+        return _dejsonify(json.load(f))
+
+
+def load_orbax_arrays(path: str, template=None):
+    """Restore the {"units", "velocities"} pytree.  ``template`` (a pytree
+    of ``jax.ShapeDtypeStruct`` with per-leaf ``sharding``) makes orbax/
+    tensorstore deliver each leaf ALREADY placed in the target sharding —
+    the cross-topology half of checkpoint/resume: save under one mesh,
+    restore under another (or a single chip) without a host round-trip."""
+    return _orbax_checkpointer().restore(
+        os.path.join(os.path.abspath(path), "arrays"), target=template)
 
 
 def _load_orbax(path: str) -> Dict:
-    import json
-
-    arrays = _orbax_checkpointer().restore(
-        os.path.join(os.path.abspath(path), "arrays"))
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = _dejsonify(json.load(f))
+    arrays = load_orbax_arrays(path)
+    meta = load_orbax_meta(path)
     return {**meta, "units": arrays["units"],
             "velocities": arrays["velocities"]}
