@@ -126,3 +126,56 @@ def test_yale_faces_sample_trains_from_real_files(tmp_path, monkeypatch):
     valid = dec.epoch_metrics[1]
     # 5 identities, chance err = 80%
     assert valid is not None and valid["err_pct"] < 55.0, valid
+
+
+def test_alexnet_trains_from_image_directory(tmp_path):
+    """The north-star workflow's real-data route (VERDICT r3 item 7):
+    a class-directory tree of image FILES feeds the AlexNet sample via
+    FullBatchFileImageLoader + the image_size knob, and one epoch of
+    fused training runs end to end."""
+    import os
+
+    from PIL import Image
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import alexnet
+
+    rng = np.random.default_rng(11)
+    for split, n_per in (("train", 6), ("valid", 2)):
+        for ci, cname in enumerate(("ants", "bees", "wasps")):
+            d = tmp_path / split / cname
+            os.makedirs(d)
+            for i in range(n_per):
+                # class-coded brightness so one epoch can reduce the loss
+                arr = rng.integers(0, 80, (64, 64, 3)).astype(np.uint8)
+                arr[:, :, ci] += 120
+                Image.fromarray(arr).save(str(d / f"{i}.png"))
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = str(tmp_path)
+    cfg = root.alexnet.loader
+    saved = {k: cfg.get(k) for k in ("train_dir", "valid_dir",
+                                     "image_size", "minibatch_size")}
+    saved_epochs = root.alexnet.decision.get("max_epochs")
+    try:
+        cfg.train_dir = str(tmp_path / "train")
+        cfg.valid_dir = str(tmp_path / "valid")
+        cfg.image_size = 64
+        cfg.minibatch_size = 6
+        root.alexnet.decision.max_epochs = 1
+        wf = alexnet.AlexNetWorkflow()
+        wf.initialize(device=None)
+        assert wf.loader.class_names == ["ants", "bees", "wasps"]
+        assert tuple(wf.loader.original_data.shape)[1:] == (64, 64, 3)
+        assert wf.loader.class_lengths == [0, 6, 18]
+        # the softmax head was sized from the directory tree
+        assert wf.forwards[-1].output_samples_number == 3
+        FusedTrainer(wf).run()
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+        root.alexnet.decision.max_epochs = saved_epochs
+    dec = wf.decision
+    assert bool(dec.complete)
+    assert np.isfinite(dec.epoch_metrics[2]["loss"])

@@ -23,7 +23,7 @@ from znicz_tpu.standard_workflow import StandardWorkflow
 root.alexnet.defaults({
     "loader": {"minibatch_size": 128, "n_train": 512, "n_valid": 128,
                "n_test": 0, "n_classes": 100, "image_size": 227,
-               "data_path": ""},
+               "data_path": "", "train_dir": "", "valid_dir": ""},
     "learning_rate": 0.01,
     "gradient_moment": 0.9,
     "weights_decay": 0.0005,
@@ -89,14 +89,40 @@ def make_layers(n_classes: int):
 
 
 class AlexNetWorkflow(StandardWorkflow):
+    """``root.alexnet.loader.train_dir`` (directory of class subdirs of
+    image files — the reference's file-image route) switches the loader to
+    ``FullBatchFileImageLoader`` with the ``image_size`` knob; the class
+    count then comes from the directory tree.  Otherwise data_path/.npz or
+    the procedural stand-in feed the plain AlexNetLoader."""
+
     def __init__(self, **kwargs):
         cfg = root.alexnet
-        loader = AlexNetLoader(
-            name="loader",
-            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        train_dir = cfg.loader.get("train_dir", "")
+        if train_dir:
+            import os
+
+            from znicz_tpu.loader.image import FullBatchFileImageLoader
+
+            size = int(cfg.loader.get("image_size", 227))
+            loader = FullBatchFileImageLoader(
+                name="loader", train_path=train_dir,
+                valid_path=cfg.loader.get("valid_dir", "") or None,
+                target_shape=(size, size),
+                minibatch_size=int(cfg.loader.get("minibatch_size")))
+            # class count = class SUBDIRS (scan_class_dirs' class_names
+            # rule) — not the full per-file walk, which the loader
+            # performs once itself at load_data
+            n_classes = sum(
+                os.path.isdir(os.path.join(train_dir, d))
+                for d in os.listdir(train_dir))
+        else:
+            loader = AlexNetLoader(
+                name="loader",
+                minibatch_size=int(cfg.loader.get("minibatch_size")))
+            n_classes = int(cfg.loader.get("n_classes", 100))
         super().__init__(
             name="AlexNetWorkflow", loader=loader,
-            layers=make_layers(int(cfg.loader.get("n_classes", 100))),
+            layers=make_layers(n_classes),
             loss_function="softmax",
             decision_config={
                 "max_epochs": int(cfg.decision.get("max_epochs")),
