@@ -162,6 +162,10 @@ def _build_bench_workflow(legacy: bool = False):
 
     prng.seed_all(1013)
     root.common.engine.precision = "bfloat16"   # params fp32, MXU bf16
+    # velocities stored bf16 (r4): halves optimizer-state HBM traffic in
+    # the fc update fusions; update math stays f32 and the semantics are
+    # parity-tested (tests/test_fused.py bf16_state_dtype cases)
+    root.common.engine.state_dtype = "bfloat16"
     root.alexnet.loader.minibatch_size = BATCH
     root.alexnet.loader.n_train = 2 * BATCH if legacy else N_TRAIN
     root.alexnet.loader.n_valid = BATCH if legacy else N_VALID
@@ -309,7 +313,9 @@ def main(legacy: bool = False) -> None:
     tflops = flops_step * STEPS / elapsed / 1e12
     print(json.dumps({
         "metric": ("alexnet_imagenet_train_throughput_legacy_r1_protocol"
-                   if legacy else "alexnet_imagenet_train_throughput"),
+                   if legacy else
+                   "alexnet_imagenet_train_throughput" +
+                   ("" if BATCH == 128 else f"_batch{BATCH}_variant")),
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / K40_ALEXNET_IMG_S, 3),
@@ -326,6 +332,79 @@ def main(legacy: bool = False) -> None:
         "loss_untrained": round(warmup_losses[0], 4),
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
+    }))
+
+
+def product_main(epochs: int = 40) -> None:
+    """``--product``: the PRODUCT path's throughput — ``FusedTrainer.run``
+    driving the real AlexNetWorkflow (loader state machine, Decision,
+    snapshotter gating, LR plumbing) at the bench protocol scale, NOT the
+    raw scan (VERDICT r3 item 2: 'the hot loop IS the product').
+
+    Two sync profiles measured in one process:
+      - ``deep``: pipeline_depth>1, snapshotter gated off — whole epochs
+        dispatched ahead, one fused metric pull per epoch (the tunneled-
+        host configuration);
+      - ``segmented``: default per-segment sync with the snapshotter
+        ACTIVE (gated on improvement, saving to a tmp dir) — every
+        epoch-granular consumer live.
+
+    ``warm_img_per_sec`` (compile-excluded, from the trainer's own stats)
+    is the comparable number; the JSON also carries the wall total."""
+    import tempfile
+
+    from znicz_tpu.core.config import root as _root
+
+    results = {}
+    for mode in ("deep", "segmented"):
+        from znicz_tpu.core.mutable import Bool
+
+        _root.common.engine.scan_chunk = 16
+        _root.common.engine.pipeline_depth = 8 if mode == "deep" else 1
+        wf, trainer = _build_bench_workflow()
+        # segmented pays a full device->host param writeback + a ~300 MB
+        # pickle per improved epoch — on a tunneled host that is
+        # link-bound (like staged streaming), so fewer epochs suffice to
+        # reach the warm steady state
+        n_epochs = epochs if mode == "deep" else max(4, epochs // 8)
+        _root.alexnet.decision.max_epochs = n_epochs
+        wf.decision.max_epochs = n_epochs
+        snap_dir = tempfile.mkdtemp(prefix="bench_snap_")
+        wf.snapshotter.directory = snap_dir
+        wf.snapshotter.compression = "raw"    # gzip of 300 MB would
+        # dominate the segmented wall time on one core
+        if mode == "deep":
+            # deep pipelining requires no epoch-granular host consumer
+            wf.snapshotter.gate_skip = Bool(True)
+        t0 = time.time()
+        try:
+            trainer.run()
+        finally:
+            import shutil
+
+            shutil.rmtree(snap_dir, ignore_errors=True)
+        stats = dict(trainer.stats)
+        results[mode] = {
+            "warm_img_per_sec": stats["warm_img_per_sec"],
+            "img_per_sec_incl_compile": stats["img_per_sec"],
+            "train_steps": stats["train_steps"],
+            "epochs": n_epochs,
+            "wall_s": round(time.time() - t0, 2),
+            "pipeline_depth": trainer.pipeline_depth,
+            "scan_chunk": trainer.scan_chunk,
+            "final_train_loss": round(
+                wf.decision.epoch_metrics[2]["loss"], 4),
+        }
+        assert np.isfinite(results[mode]["final_train_loss"])
+    print(json.dumps({
+        "metric": "alexnet_product_path_train_throughput",
+        "value": results["deep"]["warm_img_per_sec"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            results["deep"]["warm_img_per_sec"] / K40_ALEXNET_IMG_S, 3),
+        "epochs": epochs, "batch": BATCH,
+        "deep": results["deep"],
+        "segmented_with_snapshotter": results["segmented"],
     }))
 
 
@@ -567,9 +646,18 @@ def measure_samples() -> None:
 
 
 if __name__ == "__main__":
-    if "--samples" in sys.argv[1:]:
+    args = sys.argv[1:]
+    if "--batch" in args:
+        # labeled protocol VARIANT (not the headline): e.g. --batch 512
+        # amortizes the constant per-step weight+optimizer HBM traffic
+        # over more images (VERDICT r3 item 3c)
+        BATCH = int(args[args.index("--batch") + 1])
+        STEPS = max(1, (200 * 128) // BATCH)    # same images per window
+    if "--samples" in args:
         measure_samples()
-    elif "--stream" in sys.argv[1:]:
+    elif "--stream" in args:
         stream_main()
+    elif "--product" in args:
+        product_main()
     else:
-        main(legacy="--legacy" in sys.argv[1:])
+        main(legacy="--legacy" in args)

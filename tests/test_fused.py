@@ -175,6 +175,71 @@ def test_fused_snapshot_restore_continue(tmp_path):
                                    atol=2e-5, err_msg=name)
 
 
+def test_bf16_state_dtype_parity_mnist(tmp_path):
+    """root.common.engine.state_dtype="bfloat16" stores optimizer
+    velocities in bf16 (HBM-traffic lever, VERDICT r3 item 3a); update
+    math stays f32.  Documented semantics: the velocity is quantized once
+    per step — loss curves must track f32 within tolerance and training
+    must clearly progress."""
+    root.common.dirs.snapshots = str(tmp_path)
+    l32, w32 = run_fused(fresh_mnist(max_epochs=3))
+    root.common.engine.state_dtype = "bfloat16"
+    try:
+        wf = fresh_mnist(max_epochs=3)
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        losses = []
+        wf.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        trainer = FusedTrainer(wf)
+        for gd in wf.gds:
+            for k, a in gd._velocities.items():
+                assert str(a.dtype) == "bfloat16", (gd.name, k, a.dtype)
+        trainer.run()
+    finally:
+        root.common.engine.state_dtype = "float32"
+    np.testing.assert_allclose(l32, losses, rtol=2e-2)
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_bf16_state_dtype_parity_cifar(tmp_path):
+    """Same property on the CIFAR anchor (conv net, the BASELINE
+    config[1] gate): bf16 velocities track the f32 trajectory and the
+    anchor's beats-chance bar still holds."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import cifar
+
+    root.cifar.loader.n_train = 300
+    root.cifar.loader.n_valid = 100
+    root.cifar.loader.n_test = 0
+    root.cifar.loader.minibatch_size = 50
+    root.cifar.decision.max_epochs = 4
+    root.common.dirs.snapshots = str(tmp_path)
+
+    def run_once():
+        prng.reset(1013)
+        wf = cifar.CifarWorkflow()
+        losses = []
+        wf.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        wf.initialize(device=None)
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        FusedTrainer(wf).run()
+        return losses
+
+    l32 = run_once()
+    root.common.engine.state_dtype = "bfloat16"
+    try:
+        lb = run_once()
+    finally:
+        root.common.engine.state_dtype = "float32"
+    np.testing.assert_allclose(l32, lb, rtol=5e-2)
+    # 4 shrunk epochs move the conv net ~9% down the curve; the parity
+    # assert above is the real gate, this is just "it trains at all"
+    assert lb[-1] < lb[0] * 0.95
+
+
 def test_cross_topology_checkpoint_resume(tmp_path):
     """SHARDED orbax save under a {data:4, model:2} mesh, restored onto a
     {data:8} mesh AND onto a single device (VERDICT r3 item 5): orbax

@@ -158,3 +158,37 @@ def test_cutter_fwd_bwd():
     assert back.shape == x.shape
     assert back[:, 2:4, 1:5, :].sum() == err.sum()
     assert back.sum() == err.sum()
+
+
+def test_lrn_even_window_and_custom_vjp_parity():
+    """Even n (asymmetric window) must keep working through plain autodiff
+    (r4 review regression: reduce_window winsum broke n=4), and the odd-n
+    closed-form custom vjp must match autodiff exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.lrn import LRNormalizerForward
+
+    x = np.random.default_rng(0).normal(
+        0, 1, (2, 5, 5, 16)).astype(np.float32)
+
+    u4 = LRNormalizerForward(None, name="lrn4", n=4)
+    y4 = u4.apply({}, jnp.asarray(x))
+    g4 = jax.grad(lambda t: jnp.sum(jnp.sin(u4.apply({}, t))))(
+        jnp.asarray(x))
+    assert y4.shape == x.shape
+    assert np.isfinite(np.asarray(g4)).all()
+
+    u5 = LRNormalizerForward(None, name="lrn5", n=5)
+
+    def autodiff_ref(t):
+        padded = jnp.pad(jnp.square(t), [(0, 0)] * 3 + [(2, 2)])
+        acc = sum(padded[..., j:j + t.shape[-1]] for j in range(5))
+        return t / jnp.power(2.0 + 1e-4 * acc, 0.75)
+
+    g5 = jax.grad(lambda t: jnp.sum(jnp.sin(u5.apply({}, t))))(
+        jnp.asarray(x))
+    gr = jax.grad(lambda t: jnp.sum(jnp.sin(autodiff_ref(t))))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g5), np.asarray(gr),
+                               rtol=1e-5, atol=1e-6)

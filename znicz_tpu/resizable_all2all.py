@@ -48,10 +48,12 @@ class ResizableAll2All(All2All):
         if self.workflow is not None:
             from znicz_tpu.nn_units import GradientDescentBase
 
+            from znicz_tpu.nn_units import _state_dtype
+
             for unit in self.workflow:
                 if (isinstance(unit, GradientDescentBase)
                         and unit.forward is self and unit._velocities):
                     for k, arr in self.params().items():
                         unit._velocities[k].mem = np.zeros(
-                            arr.shape, np.float32)
+                            arr.shape, _state_dtype())
                     unit._compiled = None
