@@ -1,0 +1,168 @@
+"""Multi-host SHARDED input staging: in a 2-process x 4-device run with a
+host-staged StreamingLoader, each process must assemble and ship ONLY the
+rows of the batch shards its own devices hold (fused.py _stage_direct via
+make_array_from_callback) — the SPMD analogue of the reference's
+master/slave per-slave minibatch feed — and the training trajectory must
+match the single-process staged run."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""\
+    import json
+    import sys
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    provision_cpu_devices(4, verify=False)
+    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+
+    pid, n, port, snapdir = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3], sys.argv[4])
+    distributed_init(coordinator=f"127.0.0.1:{port}",
+                     num_processes=n, process_id=pid)
+    import numpy as np
+
+    import jax
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from tests.test_multihost_streaming import build_streaming_mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = snapdir
+    wf = build_streaming_mnist()
+    wf.initialize(device=None)
+
+    gathered = {"rows": 0}
+    orig_gather = wf.loader.host_gather
+    def counting_gather(idx):
+        idx = np.asarray(idx)
+        gathered["rows"] += int(idx.size)
+        return orig_gather(idx)
+    wf.loader.host_gather = counting_gather
+
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    mesh = make_mesh(axes=("data",))
+    trainer = FusedTrainer(wf, mesh=mesh)
+    assert trainer.staging
+    trainer.run()
+    total_served = int(wf.loader.samples_served)
+    print("RESULT " + json.dumps({
+        "pid": pid, "losses": losses, "rows_gathered": gathered["rows"],
+        "samples_served": total_served,
+        "weights_sum": {f.name: float(np.sum(f.weights.map_read()))
+                        for f in wf.forwards}}), flush=True)
+""")
+
+
+def build_streaming_mnist():
+    """A host-staged streaming MNIST workflow with a mesh-divisible batch
+    (64 over 8 data-axis devices) — shared by the workers and the
+    in-process oracle."""
+    from znicz_tpu import datasets
+    from znicz_tpu.core.config import root
+    from znicz_tpu.loader.streaming import HostArraySource, StreamingLoader
+    from znicz_tpu.samples import mnist
+
+    root.mnist.loader.n_train = 256
+    root.mnist.loader.n_valid = 64
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 64
+    root.mnist.decision.max_epochs = 2
+
+    class _Loader(StreamingLoader):
+        def __init__(self, workflow=None, name=None, **kwargs):
+            data, labels = datasets.load_or_generate(
+                None, datasets.digits, 320)
+            super().__init__(
+                workflow=workflow, name=name,
+                source=HostArraySource(
+                    data.reshape(320, -1).astype(np.float32), labels),
+                class_lengths=[0, 64, 256], device_budget_bytes=0,
+                scale=1.0, **kwargs)
+
+    orig = mnist.MnistLoader
+    mnist.MnistLoader = _Loader
+    try:
+        return mnist.MnistWorkflow()
+    finally:
+        mnist.MnistLoader = orig
+
+
+def test_two_process_staged_streaming_shards_the_input(tmp_path):
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    # in-process oracle: single-process staged streaming on the 8-dev mesh
+    root.common.dirs.snapshots = str(tmp_path)
+    prng.reset(1013)
+    wf = build_streaming_mnist()
+    wf.initialize(device=None)
+    oracle_losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: oracle_losses.append(d.epoch_metrics[2]["loss"]))
+    tr = FusedTrainer(wf, mesh=make_mesh(axes=("data",)))
+    assert tr.staging
+    tr.run()
+    oracle_weights = {f.name: float(np.sum(f.weights.map_read()))
+                      for f in wf.forwards}
+
+    worker = tmp_path / "mhs_worker.py"
+    worker.write_text(WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(n), str(port),
+         str(tmp_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in range(n)]
+    results = {}
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, stderr = proc.communicate(timeout=420)
+            assert proc.returncode == 0, (pid, stderr[-3000:])
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            results[pid] = json.loads(line[len("RESULT "):])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], oracle_losses,
+                               rtol=1e-4)
+    for pid in range(n):
+        for name, s in oracle_weights.items():
+            np.testing.assert_allclose(
+                results[pid]["weights_sum"][name], s, rtol=1e-3,
+                err_msg=f"proc {pid} {name}")
+        # THE sharding property: each process host-gathered only (about)
+        # HALF the rows the run consumed.  samples_served counts every
+        # sample the loader state machine handed out; the oracle gathers
+        # all of them, a 2-process worker only its own shards (plus eval
+        # replication slack).
+        served = results[pid]["samples_served"]
+        gathered = results[pid]["rows_gathered"]
+        assert gathered <= 0.75 * served, (pid, gathered, served)

@@ -289,6 +289,7 @@ def test_bench_stream_protocol_smoke(capsys):
         "minibatch_size", "n_train", "n_valid", "n_classes", "image_size")}
     saved_epochs = root.alexnet.decision.get("max_epochs")
     saved_precision = root.common.engine.get("precision", "float32")
+    saved_state = root.common.engine.get("state_dtype", "float32")
     root.alexnet.loader.image_size = 64
     try:
         bench.BATCH, bench.STEPS = 8, 4
@@ -304,6 +305,7 @@ def test_bench_stream_protocol_smoke(capsys):
             setattr(root.alexnet.loader, k, v)
         root.alexnet.decision.max_epochs = saved_epochs
         root.common.engine.precision = saved_precision
+        root.common.engine.state_dtype = saved_state
     out = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(out)
     assert rec["metric"] == "alexnet_stream_train_throughput_u8_resident"

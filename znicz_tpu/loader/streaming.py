@@ -16,13 +16,17 @@ TPU-native design, three residency regimes behind ONE loader:
      is indistinguishable from f32-resident (bench `--stream`).
   3. **host-staged** (large data): the dataset lives on the host (numpy,
      memmap, or decode-on-demand image files).  The fused driver stages
-     each scan segment — `host_gather` assembles the K*B contiguous
-     sample rows (native C++ row gather when available), `device_put`
-     ships them (u8 over the wire, decode on device), and the scan reads
-     the staged buffer with LOCAL indices.  Dispatch is async, so segment
-     N+1's host assembly + transfer overlap segment N's device compute
-     (double buffering without threads — there is nothing to wait on
-     until the metrics flush).  Steady state:
+     each scan segment as (K, B, ...) minibatch tensors consumed
+     directly by the scan xs — `host_gather` assembles the rows (native
+     C++ row gather when available) and ships them batch-sharded over
+     the mesh's ``data`` axis (u8 over the wire, decode on device).  In
+     a MULTI-HOST run each process gathers ONLY the rows of the batch
+     shards its own devices hold (`FusedTrainer._stage_direct`) — the
+     SPMD analogue of the reference's per-slave minibatch feed.
+     Dispatch is async, so segment N+1's host assembly + transfer
+     overlap segment N's device compute (double buffering without
+     threads — there is nothing to wait on until the metrics flush).
+     Steady state:
      ``img/s = min(compute rate, H2D bytes/s / bytes-per-sample)`` —
      u8 staging needs ~1.6 GB/s for AlexNet-227 at the r3 compute rate,
      i.e. any real PCIe-attached TPU host is compute-bound; tunneled dev

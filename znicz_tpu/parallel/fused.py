@@ -366,32 +366,50 @@ class FusedTrainer:
                 return NamedSharding(mesh, P("model"))
         return NamedSharding(mesh, P())
 
-    def _gather_decode(self, dataset, idx):
-        """Minibatch gather + storage decode IN-GRAPH: a u8 dataset (HBM
-        u8-residency or a host-staged u8 segment — loader/streaming.py)
-        decodes ``u8*scale + shift`` fused into the gather, so HBM/link
-        traffic stays 1 byte/value and the f32 tensor only ever exists
-        inside the step."""
+    def _decode(self, data):
+        """Storage decode IN-GRAPH: u8 data (HBM u8-residency or a
+        host-staged u8 segment — loader/streaming.py) decodes
+        ``u8*scale + shift``, fused by XLA into whatever produced it, so
+        HBM/link traffic stays 1 byte/value and the f32 tensor only ever
+        exists inside the step."""
         import jax.numpy as jnp
 
-        data = jnp.take(dataset, idx, axis=0)
         if data.dtype == jnp.uint8:
             scale, shift = self._decode_params
             data = data.astype(jnp.float32) * scale + shift
         return data
 
+    def _gather_decode(self, dataset, idx):
+        import jax.numpy as jnp
+
+        return self._decode(jnp.take(dataset, idx, axis=0))
+
     def _step_core(self, params, velocities, hypers, dataset, targets, idx,
                    batch_size, key):
         """One pure train step (traced): gather -> fwd -> grads -> per-layer
-        sgd update.  Shared by the single-step jit and the scan chunk."""
+        sgd update.  Shared by the single-step jit and the scan chunk.
+        The gather hands RAW storage-dtype rows to ``_update_core``, which
+        owns the decode (single decode point on the update path)."""
+        import jax.numpy as jnp
+
+        return self._update_core(params, velocities, hypers,
+                                 jnp.take(dataset, idx, axis=0),
+                                 jnp.take(targets, idx, axis=0),
+                                 batch_size, key)
+
+    def _update_core(self, params, velocities, hypers, data, tgt,
+                     batch_size, key):
+        """The post-gather step math: fwd -> grads -> per-layer sgd
+        update, on an already-materialized minibatch (the gather path and
+        the staged-direct path share it)."""
         import jax
 
-        data = self._gather_decode(dataset, idx)
-        tgt = jax.numpy.take(targets, idx, axis=0)
+        data = self._decode(data)
         if self.mesh is not None:
-            # dataset stays replicated; the gathered minibatch is what
-            # shards over the data axis (XLA then keeps the whole
-            # fwd/bwd batch-sharded and psums the grads over ICI)
+            # the minibatch is what shards over the data axis (XLA then
+            # keeps the whole fwd/bwd batch-sharded and psums the grads
+            # over ICI); for staged-direct inputs already sharded this
+            # way the constraint is a no-op
             from znicz_tpu.parallel.mesh import data_sharding
 
             shard = data_sharding(self.mesh)
@@ -435,42 +453,66 @@ class FusedTrainer:
                 if self.loss_kind == "softmax" and self.compute_confusion
                 else 1)
 
-    def _train_scan_body(self, dataset, targets, base_key):
-        """The ONE home of the scanned train-step body (segmented chunks
-        and the deep epoch fn share it): carry = (params, velocities,
-        confusion sum), xs = (idx, batch_size, step_number, hypers row).
-        Per-step keys are ``fold_in(base, step)`` IN-GRAPH — identical to
-        the sequential path's draws (eager key construction costs several
-        dispatches each, ~3ms/key on tunneled links).  Confusion SUMS on
-        device in the carry: stacking K (C,C) matrices and pulling them
-        per step was the real-training bottleneck on slow links (28MB/
-        segment for the 1000-class head); the Decision only accumulates."""
+    def _train_body(self, base_key, unpack):
+        """The ONE home of the scanned train-step body — the gather
+        variant (resident datasets, xs carry indices) and the staged-
+        direct variant (xs carry the minibatches themselves) share it via
+        ``unpack(xs) -> (data, tgt, bs, step, hypers)``: carry = (params,
+        velocities, confusion sum).  Per-step keys are ``fold_in(base,
+        step)`` IN-GRAPH — identical to the sequential path's draws
+        (eager key construction costs several dispatches each, ~3ms/key
+        on tunneled links).  Confusion SUMS on device in the carry:
+        stacking K (C,C) matrices and pulling them per step was the
+        real-training bottleneck on slow links (28MB/segment for the
+        1000-class head); the Decision only accumulates."""
         import jax
 
         def body(carry, xs):
             p, v, conf_acc = carry
-            idx, bs, step, hypers = xs
+            data, tgt, bs, step, hypers = unpack(xs)
             key = jax.random.fold_in(base_key, step)
-            p, v, (loss, n_err, conf) = self._step_core(
-                p, v, hypers, dataset, targets, idx, bs, key)
+            p, v, (loss, n_err, conf) = self._update_core(
+                p, v, hypers, data, tgt, bs, key)
             return (p, v, conf_acc + conf), (loss, n_err)
 
         return body
 
-    def _eval_scan_body(self, params, dataset, targets):
-        """The ONE home of the scanned eval body (params frozen — a pure
-        map): carry = confusion sum, xs = (idx, batch_size)."""
+    def _train_scan_body(self, dataset, targets, base_key):
+        """Gather variant of ``_train_body``: xs = (idx, batch_size,
+        step_number, hypers row), rows gathered from the resident
+        dataset (used by the segmented chunks and the deep epoch fn)."""
         import jax.numpy as jnp
 
+        def unpack(xs):
+            idx, bs, step, hypers = xs
+            return (jnp.take(dataset, idx, axis=0),
+                    jnp.take(targets, idx, axis=0), bs, step, hypers)
+
+        return self._train_body(base_key, unpack)
+
+    def _eval_body(self, params, unpack):
+        """The ONE home of the scanned eval body (params frozen — a pure
+        map): carry = confusion sum; ``unpack(xs) -> (decoded data, tgt,
+        bs)``."""
+
         def body(conf_acc, xs):
-            idx, bs = xs
-            data = self._gather_decode(dataset, idx)
-            tgt = jnp.take(targets, idx, axis=0)
+            data, tgt, bs = unpack(xs)
             _, (loss, n_err, conf) = self.loss_and_metrics(
                 params, data, tgt, bs, self._key0, train=False)
             return conf_acc + conf, (loss, n_err)
 
         return body
+
+    def _eval_scan_body(self, params, dataset, targets):
+        """Gather variant of ``_eval_body``: xs = (idx, batch_size)."""
+        import jax.numpy as jnp
+
+        def unpack(xs):
+            idx, bs = xs
+            return (self._gather_decode(dataset, idx),
+                    jnp.take(targets, idx, axis=0), bs)
+
+        return self._eval_body(params, unpack)
 
     def make_train_scan(self):
         """K steps in ONE dispatch via ``lax.scan`` over stacked
@@ -665,36 +707,132 @@ class FusedTrainer:
         return (params, velocities, dataset, targets,
                 lambda x: global_put(x, repl))
 
-    def _stage_segment(self, idx_rows, put):
-        """Assemble + ship ONE dispatch's samples (streaming regime 3):
-        host-gather the segment's rows in storage dtype (u8 crosses the
-        link as u8 — 4x less traffic — and decodes in-graph), device_put
-        them asynchronously, and renumber: the scan reads the staged
-        buffer with LOCAL indices 0..K*B-1.  Returns (data, targets,
-        local_idx_matrix)."""
-        loader = self.loader
-        flat = np.concatenate([np.asarray(r, np.int32) for r in idx_rows])
-        data = put(loader.host_gather(flat))
-        if self.loss_kind == "softmax":
-            tgt = put(loader.host_gather_labels(flat))
-        else:
-            tgt = put(loader.host_gather_targets(flat))
-        local = np.arange(len(flat), dtype=np.int32).reshape(
-            len(idx_rows), len(idx_rows[0]))
-        return data, tgt, local
+    def _stage_direct(self, idx_rows, put):
+        """Assemble + ship ONE dispatch's samples (streaming regime 3) as
+        (K, B, ...) minibatch tensors consumed DIRECTLY by the staged
+        step/scan variants (no in-step gather).  Storage dtype crosses
+        the link (u8 is 4x less traffic; decode happens in-graph).
 
-    def _feed_ops(self, idx_rows, put, dataset, targets):
-        """(dataset, targets, idx) operands for one dispatch: the resident
-        arrays with global indices, or a freshly staged segment with local
-        ones.  ``idx_rows`` is a list of per-step index vectors; a single
-        row yields a 1-D idx (the single-step/tail calls)."""
-        if self.staging:
-            data, tgt, local = self._stage_segment(idx_rows, put)
-            idx = local[0] if len(idx_rows) == 1 else local
-            return data, tgt, put(idx)
-        idx = (np.asarray(idx_rows[0], np.int32) if len(idx_rows) == 1
-               else np.stack(idx_rows))
-        return dataset, targets, put(idx)
+        Placement: on a mesh the tensors are batch-sharded
+        ``P(None, "data")``; in a MULTI-CONTROLLER run each process
+        host-gathers ONLY the rows of the batch shards its own devices
+        hold (jax.make_array_from_callback) — the SPMD analogue of the
+        reference's master/slave per-slave minibatch feed: no host ever
+        touches another host's samples.  Dispatch is async either way, so
+        segment N+1's assembly overlaps segment N's compute."""
+        import jax
+
+        loader = self.loader
+        idx_mat = np.stack([np.asarray(r, np.int32) for r in idx_rows])
+        n_steps, batch = idx_mat.shape
+        if self.loss_kind == "softmax":
+            tgt_gather = loader.host_gather_labels
+            tgt_sample = ()
+        else:
+            tgt_gather = loader.host_gather_targets
+            tgt_sample = tuple(loader.original_targets.mem.shape[1:])
+        shape_d = (n_steps, batch) + tuple(loader.source.sample_shape)
+        shape_t = (n_steps, batch) + tgt_sample
+        if self.mesh is None:
+            flat = idx_mat.reshape(-1)
+            return (put(loader.host_gather(flat).reshape(shape_d)),
+                    put(tgt_gather(flat).reshape(shape_t)))
+        if batch % self.mesh.shape["data"]:
+            # explicit batch-sharded placement needs divisibility (unlike
+            # the in-step constraint, which pads) — stage replicated and
+            # let the constraint shard.  Multi-controller loses the
+            # gather-own-rows-only property for such batch sizes.
+            flat = idx_mat.reshape(-1)
+            return (put(loader.host_gather(flat).reshape(shape_d)),
+                    put(tgt_gather(flat).reshape(shape_t)))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh_d = NamedSharding(self.mesh, P(None, "data"))
+        sh_t = NamedSharding(self.mesh, P(None, "data"))
+        if jax.process_count() == 1:
+            flat = idx_mat.reshape(-1)
+            return (jax.device_put(
+                loader.host_gather(flat).reshape(shape_d), sh_d),
+                jax.device_put(tgt_gather(flat).reshape(shape_t), sh_t))
+
+        def cb(gather, index):
+            # index: per-shard slices over (step, batch, *sample); only
+            # the batch dim is sharded — gather exactly those rows
+            ks = range(*index[0].indices(n_steps))
+            rows = np.stack([gather(idx_mat[k, index[1]]) for k in ks])
+            return rows[(slice(None), slice(None)) + tuple(index[2:])]
+
+        return (jax.make_array_from_callback(
+                    shape_d, sh_d, lambda i: cb(loader.host_gather, i)),
+                jax.make_array_from_callback(
+                    shape_t, sh_t, lambda i: cb(tgt_gather, i)))
+
+    def make_train_scan_direct(self):
+        """The staged twin of ``make_train_scan``: K steps in one
+        dispatch, with the K minibatches riding in the scan xs as
+        (K, B, ...) tensors instead of being gathered from a resident
+        dataset (same ``_train_body``).  Sliced per step, each (B, ...)
+        batch keeps its ``data`` sharding — no gather, no resharding."""
+        import jax
+        import jax.numpy as jnp
+
+        nc = self._n_confusion()
+
+        def chunk(params, velocities, hypers_mat, data_seg, tgt_seg,
+                  bs_vec, base_key, step_nums):
+            (p, v, conf_sum), ms = jax.lax.scan(
+                self._train_body(base_key, lambda xs: xs),
+                (params, velocities, jnp.zeros((nc, nc), jnp.int32)),
+                (data_seg, tgt_seg, bs_vec, step_nums, hypers_mat))
+            return p, v, ms, conf_sum
+
+        return jax.jit(chunk, donate_argnums=(0, 1))
+
+    def make_eval_scan_direct(self):
+        import jax
+        import jax.numpy as jnp
+
+        nc = self._n_confusion()
+
+        @jax.jit
+        def chunk(params, data_seg, tgt_seg, bs_vec):
+            def unpack(xs):
+                data, tgt, bs = xs
+                return self._decode(data), tgt, bs
+
+            conf_sum, ms = jax.lax.scan(
+                self._eval_body(params, unpack),
+                jnp.zeros((nc, nc), jnp.int32),
+                (data_seg, tgt_seg, bs_vec))
+            return ms, conf_sum
+
+        return chunk
+
+    def make_train_step_direct(self):
+        """Tail-update twin of ``make_train_step`` for staged (1, B, ...)
+        minibatch tensors."""
+        import jax
+
+        def step(params, velocities, hypers, data_seg, tgt_seg,
+                 batch_size, key):
+            return self._update_core(params, velocities, hypers,
+                                     data_seg[0], tgt_seg[0], batch_size,
+                                     key)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def make_eval_step_direct(self):
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(5,))
+        def step(params, data_seg, tgt_seg, batch_size, key, train):
+            _, metrics = self.loss_and_metrics(
+                params, self._decode(data_seg[0]), tgt_seg[0], batch_size,
+                key, train=train)
+            return metrics
+
+        return step
 
     def _advance_lr(self):
         if self._lr_adjust is not None:
@@ -744,12 +882,20 @@ class FusedTrainer:
 
         wf = self.workflow
         loader, decision = self.loader, self.decision
-        if self._train_step is None:
-            self._train_step = self.make_train_step()
-            self._eval_step = self.make_eval_step()
-        if self._train_scan is None and self.scan_chunk > 1:
-            self._train_scan = self.make_train_scan()
-            self._eval_scan = self.make_eval_scan()
+        staging = self.staging
+        if staging:
+            if self._train_step is None:
+                self._train_step = self.make_train_step_direct()
+                self._eval_step = self.make_eval_step_direct()
+                self._train_scan = self.make_train_scan_direct()
+                self._eval_scan = self.make_eval_scan_direct()
+        else:
+            if self._train_step is None:
+                self._train_step = self.make_train_step()
+                self._eval_step = self.make_eval_step()
+            if self._train_scan is None and self.scan_chunk > 1:
+                self._train_scan = self.make_train_scan()
+                self._eval_scan = self.make_eval_scan()
         self._reset_accounting()
         params, velocities, dataset, targets, put = self._device_state()
         feed_decision = self._feed_decision
@@ -844,28 +990,43 @@ class FusedTrainer:
                             pending = nxt
                             break
                     gen = prng.get("fused_trainer")
-                    dset, tgts, idx_op = self._feed_ops(
-                        [s["idx"] for s in seg], put, dataset, targets)
-                    if len(seg) == 1:
+
+                    def seg_ops():
+                        return (put(np.array([s["size"] for s in seg],
+                                             np.int32)),
+                                put(np.arange(self.steps_done,
+                                              self.steps_done + len(seg),
+                                              dtype=np.int32)))
+
+                    if staging:
+                        # staged-direct: minibatches ride in the scan xs
+                        # (even a lone step goes through the K=1 scan)
+                        dseg, tseg = self._stage_direct(
+                            [s["idx"] for s in seg], put)
+                        bs_vec, steps = seg_ops()
+                        params, velocities, ms, conf_sum = \
+                            self._train_scan(
+                                params, velocities,
+                                put(hypers_rows(len(seg))), dseg, tseg,
+                                bs_vec, put(gen.jax_base_key()), steps)
+                        result = ("scan", (ms, conf_sum))
+                    elif len(seg) == 1:
                         key = gen.jax_key(self.steps_done)
                         params, velocities, metrics = self._train_step(
-                            params, velocities, self.hypers(), dset,
-                            tgts, idx_op,
+                            params, velocities, self.hypers(), dataset,
+                            targets, put(seg[0]["idx"]),
                             np.int32(seg[0]["size"]), key)
                         advance_lr()
                         result = ("single", metrics)
                     else:
-                        bs_vec = put(np.array([s["size"] for s in seg],
-                                              np.int32))
-                        steps = np.arange(self.steps_done,
-                                          self.steps_done + len(seg),
-                                          dtype=np.int32)
+                        idx_op = put(np.stack([s["idx"] for s in seg]))
+                        bs_vec, steps = seg_ops()
                         params, velocities, ms, conf_sum = \
                             self._train_scan(
                                 params, velocities,
-                                put(hypers_rows(len(seg))), dset,
-                                tgts, idx_op, bs_vec,
-                                put(gen.jax_base_key()), put(steps))
+                                put(hypers_rows(len(seg))), dataset,
+                                targets, idx_op, bs_vec,
+                                put(gen.jax_base_key()), steps)
                         result = ("scan", (ms, conf_sum))
                     self.steps_done += len(seg)
                     flush()             # previous segment, AFTER dispatch
@@ -876,20 +1037,29 @@ class FusedTrainer:
                     # update applies only if gd_skip stayed open
                     # (unit-path parity).  The epoch's device-side
                     # confusion sum rides along in this one transfer.
-                    dset, tgts, idx = self._feed_ops([mb["idx"]], put,
-                                                     dataset, targets)
                     bs = np.int32(mb["size"])
                     key = prng.get("fused_trainer").jax_key(self.steps_done)
-                    loss, n_err, conf = self._eval_step(
-                        params, dset, tgts, idx, bs, key, True)
+                    if staging:
+                        dseg, tseg = self._stage_direct([mb["idx"]], put)
+                        loss, n_err, conf = self._eval_step(
+                            params, dseg, tseg, bs, key, True)
+                    else:
+                        idx = put(mb["idx"])
+                        loss, n_err, conf = self._eval_step(
+                            params, dataset, targets, idx, bs, key, True)
                     if epoch_conf is not None:
                         conf = epoch_conf + conf
                         epoch_conf = None
                     feed_decision(mb, (loss, n_err, conf))
                     if not bool(decision.gd_skip):
-                        params, velocities, _ = self._train_step(
-                            params, velocities, self.hypers(), dset,
-                            tgts, idx, bs, key)
+                        if staging:
+                            params, velocities, _ = self._train_step(
+                                params, velocities, self.hypers(), dseg,
+                                tseg, bs, key)
+                        else:
+                            params, velocities, _ = self._train_step(
+                                params, velocities, self.hypers(),
+                                dataset, targets, idx, bs, key)
                         advance_lr()    # adj is gated like the gds
                     self.steps_done += 1
                     account(1, mb["size"], t_iter, True, kind="tail")
@@ -909,17 +1079,27 @@ class FusedTrainer:
                         else:
                             pending = nxt
                             break
-                    dset, tgts, idx_op = self._feed_ops(
-                        [s["idx"] for s in seg], put, dataset, targets)
-                    if len(seg) == 1:
-                        stacked = [self._eval_step(
-                            params, dset, tgts, idx_op,
-                            np.int32(mb["size"]), self._key0, False)]
-                    else:
+                    if staging:
+                        dseg, tseg = self._stage_direct(
+                            [s["idx"] for s in seg], put)
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
                         ms, conf_sum = self._eval_scan(
-                            params, dset, tgts, idx_op, bs_vec)
+                            params, dseg, tseg, bs_vec)
+                        losses, n_errs = (np.asarray(m) for m in ms)
+                        stacked = [(losses[i], n_errs[i],
+                                    conf_sum if i == 0 else None)
+                                   for i in range(len(seg))]
+                    elif len(seg) == 1:
+                        stacked = [self._eval_step(
+                            params, dataset, targets, put(mb["idx"]),
+                            np.int32(mb["size"]), self._key0, False)]
+                    else:
+                        idx_op = put(np.stack([s["idx"] for s in seg]))
+                        bs_vec = put(np.array([s["size"] for s in seg],
+                                              np.int32))
+                        ms, conf_sum = self._eval_scan(
+                            params, dataset, targets, idx_op, bs_vec)
                         losses, n_errs = (np.asarray(m) for m in ms)
                         # segment confusion fed once, with the first step
                         stacked = [(losses[i], n_errs[i],
