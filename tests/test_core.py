@@ -242,6 +242,27 @@ class TestArray:
         with pytest.raises(RuntimeError):
             Array().map_read()
 
+    def test_donated_devmem_recovers_from_host(self):
+        """A donating jit may consume a buffer that (CPU backend) aliases
+        the Array's devmem; the Array must recover from its host copy —
+        and refuse with a clear error when the device value was newer."""
+        import jax
+
+        eat = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+        arr = Array(np.ones((64, 1024), np.float32))
+        _ = eat(arr.devmem)                  # donates (and deletes) it
+        np.testing.assert_array_equal(
+            np.asarray(arr.devmem), np.ones((64, 1024), np.float32))
+
+        arr2 = Array(np.ones(4, np.float32))
+        import jax.numpy as jnp
+
+        arr2.devmem = jax.device_put(np.full(4, 2.0, np.float32))
+        _ = eat2 = jax.jit(lambda x: x * 2, donate_argnums=(0,))(arr2.devmem)
+        if arr2._devmem_deleted():           # small arrays may copy
+            with pytest.raises(RuntimeError, match="donat"):
+                arr2.map_read()
+
     def test_host_rewrite_cannot_corrupt_device_value(self):
         """jax.device_put on the CPU backend ZERO-COPIES large aligned
         numpy arrays — after unmap, in-place host writes would mutate the

@@ -179,3 +179,58 @@ def test_alexnet_trains_from_image_directory(tmp_path):
     dec = wf.decision
     assert bool(dec.complete)
     assert np.isfinite(dec.epoch_metrics[2]["loss"])
+
+
+def test_alexnet_streams_from_image_directory(tmp_path):
+    """The ImageNet-at-scale route: root.alexnet.loader.stream=True feeds
+    the SAME class-directory tree through a decode-on-demand
+    ImageFileSource + StreamingLoader — nothing decoded up front, and a
+    1 MB budget forces host-staged segments (files decoded only when a
+    dispatch stages them)."""
+    import os
+
+    from PIL import Image
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import alexnet
+
+    rng = np.random.default_rng(13)
+    for split, n_per in (("train", 6), ("valid", 2)):
+        for ci, cname in enumerate(("ants", "bees", "wasps")):
+            d = tmp_path / split / cname
+            os.makedirs(d)
+            for i in range(n_per):
+                arr = rng.integers(0, 80, (64, 64, 3)).astype(np.uint8)
+                arr[:, :, ci] += 120
+                Image.fromarray(arr).save(str(d / f"{i}.png"))
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = str(tmp_path)
+    cfg = root.alexnet.loader
+    saved = {k: cfg.get(k) for k in
+             ("train_dir", "valid_dir", "image_size", "minibatch_size",
+              "stream", "stream_budget_mb")}
+    saved_epochs = root.alexnet.decision.get("max_epochs")
+    try:
+        cfg.train_dir = str(tmp_path / "train")
+        cfg.valid_dir = str(tmp_path / "valid")
+        cfg.image_size = 64
+        cfg.minibatch_size = 6
+        cfg.stream = True
+        cfg.stream_budget_mb = 0.05     # force host-staged segments
+        root.alexnet.decision.max_epochs = 1
+        wf = alexnet.AlexNetWorkflow()
+        wf.initialize(device=None)
+        assert wf.loader.streaming and not wf.loader.device_resident
+        assert wf.loader.class_lengths == [0, 6, 18]
+        assert wf.forwards[-1].output_samples_number == 3
+        trainer = FusedTrainer(wf)
+        assert trainer.staging
+        trainer.run()
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+        root.alexnet.decision.max_epochs = saved_epochs
+    assert bool(wf.decision.complete)
+    assert np.isfinite(wf.decision.epoch_metrics[2]["loss"])

@@ -120,6 +120,11 @@ class Array:
         self._device = device
 
     def map_read(self) -> np.ndarray:
+        if self._state == _DEV_DIRTY and self._devmem_deleted():
+            raise RuntimeError(
+                "Array: device buffer was donated away before its value "
+                "was read back — the data is gone.  Writeback or "
+                "map_read before handing devmem to a donating consumer.")
         if self._state == _DEV_DIRTY:
             # np.array (not asarray): asarray of a jax CPU buffer is a
             # zero-copy READ-ONLY view, which would make map_write hand out
@@ -155,8 +160,31 @@ class Array:
         self._state = _HOST_DIRTY
         return self._mem
 
+    def _devmem_deleted(self) -> bool:
+        """True when a DONATING consumer invalidated the device buffer
+        (jit with donate_argnums may consume an array that, on the CPU
+        backend, aliased this Array's devmem — e.g. a second FusedTrainer
+        built over the same workflow)."""
+        try:
+            return (self._devmem is not None
+                    and self._devmem.is_deleted())
+        except Exception:
+            return False
+
     def unmap(self):
-        """Make the device copy current; returns the jax array."""
+        """Make the device copy current; returns the jax array.  A
+        donated-away device buffer is recovered from the host copy when
+        the host is not stale; otherwise the data is genuinely gone and
+        this raises instead of returning a dead array."""
+        if self._devmem_deleted():
+            if self._state == _DEV_DIRTY or self._mem is None:
+                raise RuntimeError(
+                    "Array: device buffer was donated away and no "
+                    "current host copy exists (device value was newer). "
+                    "Writeback or map_read the Array before handing its "
+                    "devmem to a donating consumer.")
+            self._devmem = None
+            self._state = _HOST_DIRTY
         if self._state == _HOST_DIRTY or self._devmem is None:
             if self._mem is None:
                 raise RuntimeError("Array.unmap on empty Array")

@@ -23,7 +23,8 @@ from znicz_tpu.standard_workflow import StandardWorkflow
 root.alexnet.defaults({
     "loader": {"minibatch_size": 128, "n_train": 512, "n_valid": 128,
                "n_test": 0, "n_classes": 100, "image_size": 227,
-               "data_path": "", "train_dir": "", "valid_dir": ""},
+               "data_path": "", "train_dir": "", "valid_dir": "",
+               "stream": False, "stream_budget_mb": 0},
     "learning_rate": 0.01,
     "gradient_moment": 0.9,
     "weights_decay": 0.0005,
@@ -92,13 +93,44 @@ class AlexNetWorkflow(StandardWorkflow):
     """``root.alexnet.loader.train_dir`` (directory of class subdirs of
     image files — the reference's file-image route) switches the loader to
     ``FullBatchFileImageLoader`` with the ``image_size`` knob; the class
-    count then comes from the directory tree.  Otherwise data_path/.npz or
-    the procedural stand-in feed the plain AlexNetLoader."""
+    count then comes from the directory tree.  With
+    ``root.alexnet.loader.stream`` true the same directory feeds a
+    ``StreamingLoader`` over a decode-on-demand ``ImageFileSource``
+    instead — the ImageNet-at-scale route: nothing is decoded up front,
+    HBM residency is capped by ``stream_budget_mb`` (0 = the engine
+    default), and beyond it the fused driver stages minibatches straight
+    from disk.  Otherwise data_path/.npz or the procedural stand-in feed
+    the plain AlexNetLoader."""
 
     def __init__(self, **kwargs):
         cfg = root.alexnet
         train_dir = cfg.loader.get("train_dir", "")
-        if train_dir:
+        if train_dir and bool(cfg.loader.get("stream", False)):
+            from znicz_tpu.loader.image import scan_class_dirs
+            from znicz_tpu.loader.streaming import (ImageFileSource,
+                                                    StreamingLoader)
+
+            size = int(cfg.loader.get("image_size", 227))
+            valid_dir = cfg.loader.get("valid_dir", "") or None
+            # [valid | train] sample order matches the class offsets
+            v_paths, v_labels = [], []
+            if valid_dir:
+                v_paths, v_labels, v_names = scan_class_dirs(valid_dir)
+            t_paths, t_labels, names = scan_class_dirs(train_dir)
+            if valid_dir:
+                index_of = {n: i for i, n in enumerate(names)}
+                v_labels = [index_of[v_names[l]] for l in v_labels]
+            source = ImageFileSource(
+                list(v_paths) + list(t_paths),
+                list(v_labels) + list(t_labels), (size, size))
+            budget_mb = float(cfg.loader.get("stream_budget_mb", 0))
+            loader = StreamingLoader(
+                name="loader", source=source,
+                class_lengths=[0, len(v_paths), len(t_paths)],
+                device_budget_bytes=int(budget_mb * 2**20) or None,
+                minibatch_size=int(cfg.loader.get("minibatch_size")))
+            n_classes = len(names)
+        elif train_dir:
             import os
 
             from znicz_tpu.loader.image import FullBatchFileImageLoader
