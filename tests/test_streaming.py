@@ -323,3 +323,60 @@ def test_streaming_rejects_nonlinear_normalizer():
         StreamingLoader(None, name="x",
                         source=np.zeros((4, 3), np.float32),
                         normalizer=MeanDispNormalizer())
+
+
+def test_streaming_mse_without_targets_raises():
+    """A StreamingLoader built without regression targets must fail an MSE
+    fused run with a clear config error at run start, not an opaque crash
+    deep inside the staging/operand path (ADVICE r4)."""
+    from znicz_tpu.all2all import All2AllTanh
+    from znicz_tpu.core.workflow import Repeater, Workflow
+    from znicz_tpu.decision import DecisionMSE
+    from znicz_tpu.evaluator import EvaluatorMSE
+    from znicz_tpu.gd import GDTanh
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    data = np.random.RandomState(0).rand(32, 6).astype(np.float32)
+
+    class WF(Workflow):
+        def __init__(self):
+            super().__init__(name="MseStreamWF")
+            self.repeater = Repeater(self, name="repeater")
+            self.repeater.link_from(self.start_point)
+            self.loader = StreamingLoader(
+                self, name="loader", source=HostArraySource(data),
+                minibatch_size=8, class_lengths=[0, 8, 24],
+                scale=1.0, device_budget_bytes=0)
+            self.loader.link_from(self.repeater)
+            fwd = All2AllTanh(self, name="fwd0", output_sample_shape=(6,))
+            fwd.link_from(self.loader)
+            fwd.link_attrs(self.loader, ("input", "minibatch_data"))
+            self.forwards = [fwd]
+            self.evaluator = EvaluatorMSE(self, name="evaluator")
+            self.evaluator.link_from(fwd)
+            self.evaluator.link_attrs(fwd, "output")
+            self.evaluator.link_attrs(
+                self.loader, ("target", "minibatch_targets"),
+                ("batch_size", "minibatch_size"))
+            self.decision = DecisionMSE(self, name="decision", max_epochs=1)
+            self.decision.link_from(self.evaluator)
+            self.decision.link_attrs(
+                self.loader, "minibatch_class", "last_minibatch",
+                "class_ended", "epoch_number", "class_lengths",
+                "minibatch_size")
+            self.decision.link_attrs(self.evaluator,
+                                     ("minibatch_loss", "loss"))
+            gd = GDTanh(self, name="gd0", forward=fwd, learning_rate=0.01,
+                        need_err_input=False)
+            gd.link_from(self.decision)
+            gd.link_attrs(self.evaluator, ("err_output", "err_output"))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds = [gd]
+            self.repeater.link_from(gd)
+            self.end_point.link_from(self.decision)
+            self.end_point.gate_block = ~self.decision.complete
+
+    wf = WF()
+    wf.initialize(device=None)
+    with pytest.raises(ValueError, match="regression targets"):
+        FusedTrainer(wf).run()

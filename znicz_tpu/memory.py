@@ -76,6 +76,18 @@ class Array:
     def __bool__(self) -> bool:
         return self._mem is not None or self._devmem is not None
 
+    @property
+    def cross_host_sharded(self) -> bool:
+        """True when the backing device buffer is a global array actually
+        SHARDED across processes (not fully addressable, not fully
+        replicated) — host collection (map_read / np.array) cannot
+        materialize it.  Raw-attribute peek: the devmem property would
+        SYNC (device_put) a host-dirty Array just to be inspected."""
+        dm = self._devmem
+        return (dm is not None
+                and not getattr(dm, "is_fully_addressable", True)
+                and not getattr(dm, "is_fully_replicated", False))
+
     # -- shape helpers -------------------------------------------------------
 
     @property

@@ -61,12 +61,16 @@ def collect(workflow, device_arrays: bool = False) -> Dict:
                 # the resumed loader ADVANCES to the next epoch instead of
                 # repeating the one whose updates the weights already carry
                 "last_minibatch": bool(unit.last_minibatch),
+            }
+            if unit._shuffled_indices is not None:
                 # each epoch's shuffle permutes the PREVIOUS order in
                 # place, so the composed order is training state: without
                 # it a resumed run reshuffles a fresh arange and the
-                # sample order diverges from uninterrupted training
-                "shuffled_indices": np.array(unit._shuffled_indices),
-            }
+                # sample order diverges from uninterrupted training.
+                # (None = snapshot taken before the loader's first run();
+                # restore already tolerates the missing key — ADVICE r4)
+                snap["loader"]["shuffled_indices"] = \
+                    np.array(unit._shuffled_indices)
             norm = getattr(unit, "normalizer", None)
             if norm is not None:
                 snap["loader"]["normalizer"] = norm.state()
@@ -97,7 +101,14 @@ def restore(workflow, snap: Dict) -> None:
         elif isinstance(unit, GradientDescentBase) and \
                 unit.name in snap.get("velocities", {}):
             for k, a in unit._velocities.items():
-                a.mem = snap["velocities"][unit.name][k].copy()
+                # the checkpoint stores the THEN-configured state_dtype;
+                # cast to the live accumulator dtype so resuming under a
+                # different precision config neither errors nor silently
+                # overrides it (ADVICE r4)
+                leaf = np.asarray(snap["velocities"][unit.name][k])
+                a.mem = (leaf.copy() if a.mem is None
+                         or leaf.dtype == a.mem.dtype
+                         else leaf.astype(a.mem.dtype))
         elif isinstance(unit, Loader) and snap.get("loader"):
             unit.epoch_number = snap["loader"]["epoch_number"]
             unit.samples_served = snap["loader"].get("samples_served", 0)
@@ -165,7 +176,26 @@ class Snapshotter(Unit):
         if multiproc and self.format != "orbax":
             # host-format saves are not collective: every process holds
             # the same replicated state, so only process 0 writes (two
-            # writers would tear the file)
+            # writers would tear the file).  That assumption breaks for
+            # state sharded over a cross-host axis — collect() would choke
+            # on a non-addressable global array deep inside map_read, so
+            # detect it here with an actionable message (ADVICE r4).
+            for unit in self.workflow:
+                arrays = {}
+                if hasattr(unit, "params"):
+                    arrays.update(unit.params())
+                arrays.update(getattr(unit, "_velocities", None) or {})
+                for a in arrays.values():
+                    # fully-REPLICATED global arrays are fine (every
+                    # process holds a complete copy, np.array works);
+                    # only state actually SHARDED across hosts cannot be
+                    # host-collected (ADVICE r4)
+                    if getattr(a, "cross_host_sharded", False):
+                        raise ValueError(
+                            f"snapshot format={self.format!r}: "
+                            f"{unit.name} holds state sharded across "
+                            "hosts; host-format saves assume replicated "
+                            "state — use format='orbax', sharded=True")
             if jax.process_index() != 0:
                 self.destination = path
                 return path
