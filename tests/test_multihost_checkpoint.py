@@ -1,0 +1,185 @@
+"""Multi-host checkpoint/resume END-TO-END (VERDICT r4 item 3 / missing
+#3): a 2-OS-process {data:8} run snapshots a SHARDED orbax checkpoint
+mid-run, both workers die, a fresh 2-process run restores it and
+finishes — and the same checkpoint also restores single-process.  Both
+continued trajectories must match the uninterrupted 2-process oracle
+within the tolerances of tests/test_fused.py's cross-topology test."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one worker, two phases:
+#:   train  — run 4 epochs; the snapshotter writes a sharded orbax
+#:            checkpoint at the end of epoch 1 (interval=2) — the
+#:            preemption point; the process then runs to completion and
+#:            reports the UNINTERRUPTED trajectory (the oracle)
+#:   resume — fresh process: build, restore_sharded, continue to 4
+WORKER = textwrap.dedent("""\
+    import json
+    import sys
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    provision_cpu_devices(4, verify=False)
+    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+
+    phase, pid, n, port, snapdir = (sys.argv[1], int(sys.argv[2]),
+                                    int(sys.argv[3]), sys.argv[4],
+                                    sys.argv[5])
+    distributed_init(coordinator=f"127.0.0.1:{port}",
+                     num_processes=n, process_id=pid)
+    import numpy as np
+
+    import jax
+
+    assert jax.process_count() == n
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = snapdir
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 4
+    if phase == "train":
+        root.mnist.snapshotter.interval = 2
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    wf.snapshotter.format = "orbax"
+    wf.snapshotter.sharded = True
+
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    mesh = make_mesh(axes=("data",))
+    assert mesh.shape["data"] == 4 * n
+    trainer = FusedTrainer(wf, mesh=mesh)
+    if phase == "train":
+        trainer.run()
+        ckpt = f"{snapdir}/mnist_epoch_1.orbax"
+        import os as _os
+
+        assert _os.path.isdir(ckpt), _os.listdir(snapdir)
+    else:
+        ckpt = f"{snapdir}/mnist_epoch_1.orbax"
+        meta = trainer.restore_sharded(ckpt)
+        assert meta["epoch"] == 1, meta["epoch"]
+        # the restored leaves span the GLOBAL mesh (both processes)
+        w = wf.forwards[0].weights.devmem
+        assert len(w.sharding.device_set) == 4 * n, w.sharding
+        trainer.run()
+        assert len(losses) == 2          # epochs 2..3 ran after resume
+    assert bool(wf.decision.complete)
+    weights = {f.name: np.asarray(f.weights.map_read())
+               for f in wf.forwards}
+    np.savez(f"{snapdir}/weights_{phase}_{pid}.npz",
+             **{k: np.asarray(v, np.float32) for k, v in weights.items()})
+    print("RESULT " + json.dumps({"pid": pid, "losses": losses}),
+          flush=True)
+""")
+
+
+def _spawn_pair(phase, tmp_path):
+    worker = tmp_path / "mh_ckpt_worker.py"
+    worker.write_text(WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), phase, str(pid), str(n), str(port),
+         str(tmp_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in range(n)]
+    results = {}
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, stderr = proc.communicate(timeout=420)
+            assert proc.returncode == 0, (phase, pid, stderr[-3000:])
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            results[pid] = json.loads(line[len("RESULT "):])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+    return results
+
+
+def test_two_process_checkpoint_kill_restore_finish(tmp_path):
+    # phase 1: 2-process train; sharded orbax checkpoint lands at the end
+    # of epoch 1; the processes then FINISH the 4 epochs, making their
+    # own trajectory the uninterrupted oracle.  Both processes then exit
+    # — the "kill" (nothing of the first incarnation survives except the
+    # checkpoint directory).
+    train = _spawn_pair("train", tmp_path)
+    np.testing.assert_allclose(train[0]["losses"], train[1]["losses"],
+                               rtol=1e-6)
+    oracle_losses = train[0]["losses"]
+    assert len(oracle_losses) == 4
+
+    # phase 2: fresh 2-process incarnation restores and finishes
+    resume = _spawn_pair("resume", tmp_path)
+    np.testing.assert_allclose(resume[0]["losses"], resume[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(resume[0]["losses"], oracle_losses[2:],
+                               rtol=1e-3)
+
+    # phase 3: the SAME checkpoint restores single-process (this pytest
+    # process, its own 8 virtual devices) and matches too
+    from tests.test_fused import fresh_mnist
+    from znicz_tpu import snapshotter  # noqa: F401  (registry warm)
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = str(tmp_path)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 4
+    losses1 = []
+    wf1 = mnist.MnistWorkflow()
+    wf1.decision.on_epoch_end.append(
+        lambda d: losses1.append(d.epoch_metrics[2]["loss"]))
+    wf1.initialize(device=None)
+    tr1 = FusedTrainer(wf1)
+    tr1.restore_sharded(str(tmp_path / "mnist_epoch_1.orbax"))
+    tr1.run()
+    assert bool(wf1.decision.complete)
+    np.testing.assert_allclose(losses1, oracle_losses[2:], rtol=1e-3)
+
+    # weights: resumed (both processes) vs oracle finals
+    with np.load(tmp_path / "weights_train_0.npz") as oracle_w:
+        ow = {k: oracle_w[k] for k in oracle_w.files}
+    for pid in range(2):
+        with np.load(tmp_path / f"weights_resume_{pid}.npz") as f:
+            for name, w in ow.items():
+                np.testing.assert_allclose(
+                    f[name], w, rtol=5e-3, atol=5e-5,
+                    err_msg=f"resume proc {pid} {name}")
+    for name, w in ow.items():
+        np.testing.assert_allclose(
+            {f.name: np.array(f.weights.map_read())
+             for f in wf1.forwards}[name], w, rtol=5e-3, atol=5e-5,
+            err_msg=f"single-process {name}")

@@ -282,7 +282,8 @@ def test_bench_stream_protocol_smoke(capsys):
 
     saved = {k: getattr(bench, k) for k in (
         "BATCH", "STEPS", "N_TRAIN", "N_VALID", "N_CLASSES",
-        "N_STREAM_TILE", "N_HOST_TILE", "STAGE_SEGMENTS", "CHECK_LOSS")}
+        "N_STREAM_TILE", "N_HOST_TILE", "STAGE_SEGMENTS", "CHECK_LOSS",
+        "N_DECODE_JPG", "N_DECODE_MEASURE")}
     # _build_bench_workflow mutates process-wide config from the patched
     # bench globals — snapshot and restore everything it touches
     cfg_saved = {k: root.alexnet.loader.get(k) for k in (
@@ -297,6 +298,7 @@ def test_bench_stream_protocol_smoke(capsys):
         bench.N_STREAM_TILE, bench.N_HOST_TILE = 2, 2
         bench.STAGE_SEGMENTS = 2
         bench.CHECK_LOSS = False
+        bench.N_DECODE_JPG, bench.N_DECODE_MEASURE = 24, 16
         bench.stream_main()
     finally:
         for k, v in saved.items():
@@ -314,6 +316,14 @@ def test_bench_stream_protocol_smoke(capsys):
     st = rec["staged"]
     assert st["img_s"] > 0 and st["h2d_gbps_measured"] > 0
     assert st["roofline_img_s_at_measured_bw"] <= rec["value"] + 1e-6
+    dec = rec["decode"]
+    assert dec["img_s_serial"] > 0 and dec["img_s_pooled"] > 0
+    assert dec["workers"] >= 1
+    # three-term roofline never exceeds any single term
+    assert dec["roofline_img_s_3term"] <= rec["value"] + 1e-6
+    assert dec["roofline_img_s_3term"] <= \
+        st["roofline_img_s_at_measured_bw"] + 1e-6
+    assert dec["roofline_img_s_3term"] <= dec["img_s_pooled"] + 1e-6
 
 
 def test_streaming_rejects_nonlinear_normalizer():

@@ -26,12 +26,17 @@ TPU-native design, three residency regimes behind ONE loader:
      Dispatch is async, so segment N+1's host assembly + transfer
      overlap segment N's device compute (double buffering without
      threads — there is nothing to wait on until the metrics flush).
-     Steady state:
-     ``img/s = min(compute rate, H2D bytes/s / bytes-per-sample)`` —
-     u8 staging needs ~1.6 GB/s for AlexNet-227 at the r3 compute rate,
-     i.e. any real PCIe-attached TPU host is compute-bound; tunneled dev
-     hosts are link-bound and bench --stream records the measured link
-     bandwidth next to the throughput so the number explains itself.
+     Steady state (three-term roofline, bench --stream measures each):
+     ``img/s = min(compute rate, H2D bytes/s / bytes-per-sample,
+     decode rate)`` — u8 staging needs ~1.6 GB/s for AlexNet-227 at the
+     r3 compute rate, i.e. any real PCIe-attached TPU host is
+     compute-bound on the link; tunneled dev hosts are link-bound and
+     bench --stream records the measured link bandwidth next to the
+     throughput so the number explains itself.  The DECODE term is
+     served by the host ingest engine (loader/ingest.py): file-backed
+     sources decode on an N-worker pool, and the fused driver's
+     lookahead prefetches future segments' rows so decode overlaps
+     device compute (VERDICT r4 item 1).
 
 The residency regime is chosen at initialize: ``device_budget_bytes``
 (kwarg or ``root.common.engine.stream_budget_mb``) caps what may sit in
@@ -102,16 +107,28 @@ class HostArraySource:
 class ImageFileSource:
     """Decode-on-demand image files (the reference's file-image route at
     beyond-HBM scale): rows are decoded u8 only when a segment stages them.
-    ``paths``/``labels`` aligned; images resized to ``target_shape``."""
+    ``paths``/``labels`` aligned; images resized to ``target_shape``.
+
+    Decode runs on a ``DecodePool`` (loader/ingest.py): ``workers`` threads
+    decode a gather's rows in parallel, and ``prefetch(idx)`` starts decode
+    for rows a FUTURE segment will stage — the fused driver submits its
+    lookahead so decode overlaps device compute.  ``workers`` defaults to
+    ``root.common.engine.decode_workers`` (else one per CPU, capped);
+    ``workers=0`` forces the serial path.  Pooled and serial decode are
+    bit-identical (decode is pure), so the parallelism is invisible to
+    training math."""
 
     def __init__(self, paths: Sequence[str], labels: Sequence[int],
-                 target_shape: Tuple[int, int], grayscale: bool = False):
+                 target_shape: Tuple[int, int], grayscale: bool = False,
+                 workers: Optional[int] = None):
         assert len(paths) == len(labels)
         self.paths = list(paths)
         self.labels = np.asarray(labels, np.int32)
         self.target_shape = tuple(target_shape)
         self.grayscale = bool(grayscale)
         self.targets = None
+        self.workers = workers
+        self._pool = None
 
     def __len__(self) -> int:
         return len(self.paths)
@@ -137,7 +154,45 @@ class ImageFileSource:
             img = img.resize((self.target_shape[1], self.target_shape[0]))
             return np.asarray(img, np.uint8)
 
+    def _decode_row(self, i: int) -> np.ndarray:
+        return self._decode_u8(self.paths[i])
+
+    def pool(self):
+        """The lazily-created decode pool, or None in serial mode
+        (``workers=0``).  Even ``workers=1`` keeps the pool: a single
+        worker cannot raise the decode RATE, but prefetched rows still
+        decode on the worker thread while the training thread waits on
+        device compute — the overlap matters on any host."""
+        if self._pool is None:
+            from znicz_tpu.loader.ingest import DecodePool, default_workers
+
+            w = (default_workers() if self.workers is None
+                 else int(self.workers))
+            if w < 1:
+                return None
+            self._pool = DecodePool(self._decode_row, workers=w)
+        return self._pool
+
+    def with_workers(self, workers: int) -> "ImageFileSource":
+        """A sibling source over the same files with a different worker
+        count (measurement helper — ingest.measure_decode_rate)."""
+        return ImageFileSource(self.paths, self.labels, self.target_shape,
+                               self.grayscale, workers=workers)
+
+    def prefetch(self, idx: np.ndarray) -> int:
+        """Start decoding rows a future gather will consume (bounded;
+        see DecodePool.submit).  Returns rows newly submitted."""
+        pool = self.pool()
+        return pool.submit(idx) if pool is not None else 0
+
+    @property
+    def ingest_stats(self) -> Optional[dict]:
+        return None if self._pool is None else dict(self._pool.stats)
+
     def gather(self, idx: np.ndarray) -> np.ndarray:
+        pool = self.pool()
+        if pool is not None:
+            return pool.take(idx)
         return np.stack([self._decode_u8(self.paths[i]) for i in idx])
 
     def whole(self) -> np.ndarray:
@@ -244,6 +299,20 @@ class StreamingLoader(Loader):
         the device decodes)."""
         return self.source.gather(np.asarray(idx, np.int32))
 
+    def prefetch_rows(self, idx: np.ndarray) -> int:
+        """Hint that a FUTURE host_gather will need these rows: sources
+        with a decode pool (ImageFileSource) start decoding them now so
+        the decode overlaps device compute (loader/ingest.py).  No-op for
+        memcpy-cheap sources.  Returns rows newly submitted."""
+        fn = getattr(self.source, "prefetch", None)
+        return int(fn(np.asarray(idx, np.int32))) if fn is not None else 0
+
+    @property
+    def ingest_stats(self) -> Optional[dict]:
+        """Decode-pool counters (prefetch_hits / decode_misses / ...) or
+        None when the source has no pool."""
+        return getattr(self.source, "ingest_stats", None)
+
     def host_gather_labels(self, idx: np.ndarray) -> np.ndarray:
         return np.take(self.original_labels.mem,
                        np.asarray(idx, np.int32), axis=0)
@@ -277,10 +346,12 @@ class StreamingLoader(Loader):
 
 
 def class_dir_source(base: str, target_shape: Tuple[int, int],
-                     grayscale: bool = False) -> ImageFileSource:
+                     grayscale: bool = False,
+                     workers: Optional[int] = None) -> ImageFileSource:
     """<base>/<class>/*.img -> a decode-on-demand source (the directory
     layout of loader/image.py, without the resident decode)."""
     from znicz_tpu.loader.image import scan_class_dirs
 
     paths, labels, _names = scan_class_dirs(base)
-    return ImageFileSource(paths, labels, target_shape, grayscale)
+    return ImageFileSource(paths, labels, target_shape, grayscale,
+                           workers=workers)
