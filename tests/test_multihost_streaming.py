@@ -166,3 +166,142 @@ def test_two_process_staged_streaming_shards_the_input(tmp_path):
         served = results[pid]["samples_served"]
         gathered = results[pid]["rows_gathered"]
         assert gathered <= 0.75 * served, (pid, gathered, served)
+
+
+IMG_WORKER = textwrap.dedent("""\
+    import json
+    import sys
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    provision_cpu_devices(4, verify=False)
+    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+
+    pid, n, port, imgdir, snapdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                     sys.argv[3], sys.argv[4], sys.argv[5])
+    distributed_init(coordinator=f"127.0.0.1:{port}",
+                     num_processes=n, process_id=pid)
+    import numpy as np
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from tests.test_multihost_streaming import build_imagefile_mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = snapdir
+    wf = build_imagefile_mnist(imgdir, workers=2)
+    wf.initialize(device=None)
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    trainer = FusedTrainer(wf, mesh=make_mesh(axes=("data",)))
+    assert trainer.staging
+    trainer.run()
+    stats = wf.loader.ingest_stats
+    print("RESULT " + json.dumps({
+        "pid": pid, "losses": losses, "ingest": stats,
+        "samples_served": int(wf.loader.samples_served),
+        "weights_sum": {f.name: float(np.sum(f.weights.map_read()))
+                        for f in wf.forwards}}), flush=True)
+""")
+
+
+def build_imagefile_mnist(imgdir, workers):
+    """Host-staged streaming MNIST-shaped workflow over a decode-on-demand
+    image-file source with a decode pool of ``workers`` threads."""
+    from znicz_tpu.core.config import root
+    from znicz_tpu.loader.streaming import StreamingLoader, class_dir_source
+    from znicz_tpu.samples import mnist
+
+    root.mnist.loader.n_train = 256
+    root.mnist.loader.n_valid = 64
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 64
+    root.mnist.decision.max_epochs = 2
+
+    class _Loader(StreamingLoader):
+        def __init__(self, workflow=None, name=None, **kwargs):
+            super().__init__(
+                workflow=workflow, name=name,
+                source=class_dir_source(imgdir, target_shape=(12, 12),
+                                        workers=workers),
+                class_lengths=[0, 64, 256], device_budget_bytes=0,
+                **kwargs)
+
+    orig = mnist.MnistLoader
+    mnist.MnistLoader = _Loader
+    try:
+        return mnist.MnistWorkflow()
+    finally:
+        mnist.MnistLoader = orig
+
+
+def test_two_process_imagefile_ingest_prefetches_own_rows(tmp_path):
+    """The host INGEST engine in a 2-process run (the untested half of
+    loader/ingest.py): the lookahead submits only the rows of batch
+    shards the LOCAL process holds, the decode pool serves steady-state
+    gathers from prefetched futures, and the trajectory matches the
+    single-process serial-decode oracle bit-for-bit at loss tolerance."""
+    from tests.test_streaming import _write_class_tree
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    imgdir = str(tmp_path / "imgs")
+    os.makedirs(imgdir)
+    _write_class_tree(imgdir, n_per_class=160, size=(12, 12))
+
+    # in-process oracle: single process, SERIAL decode (workers=0)
+    root.common.dirs.snapshots = str(tmp_path)
+    prng.reset(1013)
+    wf = build_imagefile_mnist(imgdir, workers=0)
+    wf.initialize(device=None)
+    oracle_losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: oracle_losses.append(d.epoch_metrics[2]["loss"]))
+    tr = FusedTrainer(wf, mesh=make_mesh(axes=("data",)))
+    assert tr.staging
+    tr.run()
+
+    worker = tmp_path / "mhi_worker.py"
+    worker.write_text(IMG_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(n), str(port),
+         imgdir, str(tmp_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in range(n)]
+    results = {}
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, stderr = proc.communicate(timeout=420)
+            assert proc.returncode == 0, (pid, stderr[-3000:])
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            results[pid] = json.loads(line[len("RESULT "):])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], oracle_losses,
+                               rtol=1e-4)
+    for pid in range(n):
+        st = results[pid]["ingest"]
+        served = results[pid]["samples_served"]
+        # own-rows-only extends to the prefetcher: each process decoded
+        # only (about) HALF the rows the run consumed
+        assert st["rows_decoded"] <= 0.75 * served, (pid, st, served)
+        # and the lookahead actually fed the queue
+        assert st["prefetch_hits"] > 0, (pid, st)
