@@ -13,8 +13,9 @@ The closed form: with ``s = k + alpha*winsum(x^2)`` and ``y = x*s^-beta``,
 
     dx = dy*s^-beta - 2*alpha*beta * x * winsum(dy * x * s^(-beta-1))
 
-i.e. backward = 2 elementwise passes + 1 channel-window sum, with only
-``(x, s)`` saved from the forward.  ``s^-beta`` for the default beta=0.75
+i.e. backward = 2 elementwise passes + 2 channel-window sums, with only
+``(x,)`` saved from the forward (``s`` is recomputed — bitwise identical,
+measured neutral, smaller residual).  ``s^-beta`` for the default beta=0.75
 is computed as ``rsqrt(s)*sqrt(rsqrt(s))`` — two pipelined VPU ops instead
 of the exp/log ``pow`` expansion.
 """
@@ -73,11 +74,18 @@ def lrn_ref(x, n: int, alpha: float, beta: float, k: float):
 
 def _lrn_ref_fwd(x, n, alpha, beta, k):
     s = k + alpha * _winsum(x * x, n)
-    return x * _inv_pow(s, beta), (x, s)
+    return x * _inv_pow(s, beta), (x,)
 
 
 def _lrn_ref_bwd(n, alpha, beta, k, res, dy):
-    x, s = res
+    # recompute s from x instead of saving it (same expression, same
+    # reduction order -> bitwise-identical).  Measured NEUTRAL on the
+    # bench headline (11,306 vs 11,296 img/s, r5): fwd and bwd live in
+    # ONE jitted step, so XLA already schedules the residual optimally —
+    # kept because the smaller residual helps remat/memory at larger
+    # batches and is never worse.
+    (x,) = res
+    s = k + alpha * _winsum(x * x, n)
     r = _inv_pow(s, beta)
     t = dy * x * (r / s)
     dx = dy * r - (2.0 * alpha * beta) * x * _winsum(t, n)
