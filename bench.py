@@ -766,6 +766,13 @@ if __name__ == "__main__":
         # over more images (VERDICT r3 item 3c)
         BATCH = int(args[args.index("--batch") + 1])
         STEPS = max(1, (200 * 128) // BATCH)    # same images per window
+    if "--master-bf16" in args:
+        # labeled VARIANT: bf16-STORED master weights (f32 update math) —
+        # halves the per-step param read+write traffic but changes
+        # convergence semantics (weight rounding); never the headline
+        from znicz_tpu.core.config import root as _r
+
+        _r.common.engine.master_dtype = "bfloat16"
     if "--samples" in args:
         measure_samples()
     elif "--stream" in args:

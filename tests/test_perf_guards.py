@@ -193,3 +193,41 @@ def test_async_snapshot_does_not_stall_training_cpu():
     gated = max(run_once(False) for _ in range(3))
     active = max(run_once(True) for _ in range(3))
     assert active >= 0.75 * gated, (active, gated)
+
+
+def test_bf16_master_weights_variant_trains():
+    """The opt-in bf16-MASTER-weights traffic lever
+    (root.common.engine.master_dtype — a labeled bench variant, never
+    the headline/anchors): params are stored bf16, update math stays
+    f32, and training still converges to the f32 run's neighborhood."""
+    from znicz_tpu.parallel.fused import FusedTrainer
+
+    from tests.test_fused import fresh_mnist, run_fused
+
+    l32, _ = run_fused(fresh_mnist(max_epochs=3))
+    root.common.engine.master_dtype = "bfloat16"
+    try:
+        wf = fresh_mnist(max_epochs=3)
+        losses = []
+        wf.decision.on_epoch_end.append(
+            lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+        tr = FusedTrainer(wf)
+        assert tr._master_dtype == "bfloat16"
+        tr.run()
+        w = wf.forwards[0].weights.map_read()
+        assert str(w.dtype) == "bfloat16"       # stored dtype really bf16
+    finally:
+        root.common.engine.master_dtype = "float32"
+    # loose band: bf16 weight rounding shifts the trajectory, it must
+    # not break it
+    assert losses[-1] < 1.5 * l32[-1] + 0.05, (losses, l32)
+
+    # and the config validates
+    root.common.engine.master_dtype = "float16"
+    try:
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="master_dtype"):
+            FusedTrainer(fresh_mnist(max_epochs=1))
+    finally:
+        root.common.engine.master_dtype = "float32"

@@ -140,6 +140,20 @@ class FusedTrainer:
                               if root.common.engine.get("precision",
                                                         "float32")
                               == "float32" else "bfloat16")
+        #: OPT-IN bf16 MASTER weights (root.common.engine.master_dtype =
+        #: "bfloat16", fused path only): params are STORED bf16 — the
+        #: per-step read+write of the full param set halves (AlexNet fc:
+        #: the dominant non-MXU traffic after the r4 bf16 velocities) —
+        #: while the update arithmetic stays f32 (cast up, update, cast
+        #: back).  This CHANGES convergence semantics (weight rounding):
+        #: a labeled bench variant (--master-bf16), never the headline
+        #: or the anchors.
+        md = str(root.common.engine.get("master_dtype", "float32"))
+        if md not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"root.common.engine.master_dtype={md!r}: must be "
+                "'float32' or 'bfloat16'")
+        self._master_dtype = None if md == "float32" else "bfloat16"
         #: u8 storage decodes to ``u8*scale + shift`` in-graph
         #: (loader/streaming.py; plain f32 loaders never hit the decode)
         self._decode_params = (np.float32(getattr(self.loader, "scale", 1.0)),
@@ -182,8 +196,20 @@ class FusedTrainer:
                 return arr.map_read()
         return arr.devmem
 
+    def _cast_master(self, v):
+        """Storage-dtype cast for a param leaf (jax array or host numpy)
+        under the bf16-master option; identity otherwise."""
+        md = self._master_dtype
+        if md is None or str(v.dtype) == md:
+            return v
+        import ml_dtypes
+
+        if isinstance(v, np.ndarray):
+            return v.astype(ml_dtypes.bfloat16)
+        return v.astype(md)
+
     def extract_params(self) -> Dict[str, Dict[str, object]]:
-        return {f.name: {k: self._op_value(a)
+        return {f.name: {k: self._cast_master(self._op_value(a))
                          for k, a in f.params().items()}
                 for f in self.forwards if f.has_weights}
 
@@ -499,12 +525,20 @@ class FusedTrainer:
             for k, w in layer_p.items():
                 g = grads[name][k].astype("float32")
                 is_bias = (k == "bias")
-                new_p[name][k], new_v[name][k] = sgd_update(
-                    w, g, velocities[name][k],
+                # bf16-master: storage bf16, update arithmetic f32 (the
+                # cast pair fuses into the update; traffic is what the
+                # storage dtype says)
+                w_in = (w if self._master_dtype is None
+                        else w.astype("float32"))
+                p_new, v_new = sgd_update(
+                    w_in, g, velocities[name][k],
                     lr=(lrb if is_bias else lr),
                     weights_decay=(wdb if is_bias else wd),
                     l1_vs_l2=l1l2,
                     momentum=(momb if is_bias else mom), clip=clip)
+                if self._master_dtype is not None:
+                    p_new = p_new.astype(self._master_dtype)
+                new_p[name][k], new_v[name][k] = p_new, v_new
         return new_p, new_v, metrics
 
     def make_train_step(self):
