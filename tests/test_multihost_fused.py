@@ -128,3 +128,111 @@ def test_two_process_fused_training_matches_single_process(tmp_path):
                 np.testing.assert_allclose(
                     f[name], w, rtol=2e-3, atol=2e-5,
                     err_msg=f"proc {pid} {name}")
+
+
+DEEP_WORKER = textwrap.dedent("""\
+    import json
+    import sys
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    provision_cpu_devices(4, verify=False)
+    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+
+    pid, n, port, snapdir = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3], sys.argv[4])
+    distributed_init(coordinator=f"127.0.0.1:{port}",
+                     num_processes=n, process_id=pid)
+    import numpy as np
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.common.dirs.snapshots = snapdir
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = 4
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+
+    losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: losses.append(d.epoch_metrics[2]["loss"]))
+    trainer = FusedTrainer(wf, mesh=make_mesh(axes=("data",)))
+    trainer.pipeline_depth = 3
+    assert trainer._deep_eligible()     # active snapshotter, async-served
+    trainer.run()
+    snap_written = int(wf.snapshotter.async_saves_written)
+    print("RESULT " + json.dumps({
+        "pid": pid, "losses": losses, "snap_written": snap_written,
+        "weights_sum": {f.name: float(np.sum(f.weights.map_read()))
+                        for f in wf.forwards}}), flush=True)
+""")
+
+
+def test_two_process_deep_pipeline_matches_single_process(tmp_path):
+    """The DEEP (whole-epoch, metrics-deferred) pipeline in a 2-process
+    global mesh — with the snapshotter ACTIVE through the async writer:
+    trajectories match the single-process deep run, and process 0 wrote
+    checkpoints."""
+    from tests.test_fused import fresh_mnist
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = fresh_mnist(max_epochs=4)
+    oracle_losses = []
+    wf.decision.on_epoch_end.append(
+        lambda d: oracle_losses.append(d.epoch_metrics[2]["loss"]))
+    tr = FusedTrainer(wf, mesh=make_mesh(axes=("data",)))
+    tr.pipeline_depth = 3
+    tr.run()
+
+    worker = tmp_path / "mh_deep_worker.py"
+    worker.write_text(DEEP_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(n), str(port),
+         str(tmp_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in range(n)]
+    results = {}
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, stderr = proc.communicate(timeout=420)
+            assert proc.returncode == 0, (pid, stderr[-3000:])
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            results[pid] = json.loads(line[len("RESULT "):])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], oracle_losses,
+                               rtol=1e-4)
+    # only process 0 writes host-format files; both report their counter
+    assert results[0]["snap_written"] > 0
+    assert results[1]["snap_written"] == 0
+    wsum = {f.name: float(np.sum(f.weights.map_read()))
+            for f in wf.forwards}
+    for pid in range(n):
+        for name, s in wsum.items():
+            np.testing.assert_allclose(
+                results[pid]["weights_sum"][name], s, rtol=1e-3,
+                err_msg=f"proc {pid} {name}")
