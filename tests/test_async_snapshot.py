@@ -179,3 +179,70 @@ def test_async_snapshot_coalesces_but_final_is_durable(tmp_path):
     # the checkpoint is internally consistent: restoring it reproduces
     # the recorded best metric
     assert np.isfinite(loaded["metric"])
+
+
+def test_cross_dtype_checkpoint_restore(tmp_path):
+    """ADVICE r4: a checkpoint stores velocities in the THEN-configured
+    state_dtype; restoring under a different configuration explicitly
+    casts to the live dtype — both for host-format restore() and for the
+    sharded-orbax restore_sharded() template path — instead of erroring
+    or silently changing the run's accumulator precision."""
+    from znicz_tpu import snapshotter as snap_mod
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel.fused import FusedTrainer
+    from znicz_tpu.samples import mnist
+
+    root.common.dirs.snapshots = str(tmp_path)
+
+    # save under bf16 optimizer state
+    root.common.engine.state_dtype = "bfloat16"
+    try:
+        _run_fused(fresh_mnist(max_epochs=2))
+    finally:
+        root.common.engine.state_dtype = "float32"
+    wf_src = None  # the snapshot file is what we need
+    pickle_path = str(tmp_path / "mnist_best.pickle.gz")
+    assert os.path.exists(pickle_path)
+
+    # restore under f32 state: velocities arrive CAST to f32
+    prng.reset(1013)
+    root.mnist.decision.max_epochs = 4
+    wf2 = mnist.MnistWorkflow()
+    wf2.initialize(device=None)
+    snap = snap_mod.Snapshotter.load(pickle_path)
+    vel_leaf = next(iter(next(iter(snap["velocities"].values())).values()))
+    assert str(vel_leaf.dtype) == "bfloat16"       # stored as configured
+    snap_mod.restore(wf2, snap)
+    for gd in wf2.gds:
+        for k, a in gd._velocities.items():
+            assert str(a.mem.dtype) == "float32", (gd.name, k)
+    tr2 = FusedTrainer(wf2)
+    tr2.run()                                      # continues cleanly
+    assert bool(wf2.decision.complete)
+
+    # sharded-orbax direction: save f32, restore under bf16 state
+    root.mnist.decision.max_epochs = 2
+    prng.reset(1013)
+    wf3 = fresh_mnist(max_epochs=2)
+    wf3.snapshotter.format = "orbax"
+    wf3.snapshotter.sharded = True
+    tr3 = FusedTrainer(wf3)
+    tr3.run()
+    orbax_path = wf3.snapshotter.destination
+    assert orbax_path and orbax_path.endswith(".orbax")
+
+    root.common.engine.state_dtype = "bfloat16"
+    try:
+        prng.reset(1013)
+        root.mnist.decision.max_epochs = 4
+        wf4 = mnist.MnistWorkflow()
+        wf4.initialize(device=None)
+        tr4 = FusedTrainer(wf4)
+        tr4.restore_sharded(orbax_path)
+        for gd in wf4.gds:
+            for k, a in gd._velocities.items():
+                assert str(a.devmem.dtype) == "bfloat16", (gd.name, k)
+        tr4.run()
+        assert bool(wf4.decision.complete)
+    finally:
+        root.common.engine.state_dtype = "float32"
