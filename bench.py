@@ -50,6 +50,13 @@ finals (MNIST / CIFAR / MnistAE / Kohonen at their default sample configs)
 and prints one JSON line per config — the numbers recorded in BASELINE.md's
 "Measured" column.
 
+``python bench.py --fused-elementwise`` runs the SAME headline protocol
+with ``root.common.engine.fused_elementwise`` on — the conv1/conv2
+bias+ReLU+LRN+maxpool block (and its backward) as one single-pass Pallas
+kernel (znicz_tpu/pallas_fused_block.py).  The JSON line records the flag;
+a with/without pair on the same host is the BASELINE.md "Fused elementwise
+block" comparison.
+
 ``python bench.py --legacy`` re-runs the round-1 protocol (100-class head,
 256 resident images, FIXED minibatch indices) so the two protocols can be
 compared on the same host/build (ADVICE r2: the recorded r1 vs r2 numbers
@@ -95,6 +102,15 @@ import time
 import numpy as np
 
 K40_ALEXNET_IMG_S = 500.0   # documented stand-in (see module docstring)
+
+#: VERDICT r5 item 7 floors: the headline protocol FAILS below this MFU
+#: (silent perf regressions must fail the bench, not pass unnoticed).
+#: Applies only where the peak is known (a recognized TPU) and only to the
+#: unmodified headline — labeled variants (--batch/--master-bf16/
+#: --fused-elementwise) report without the gate so a measured negative
+#: can still be recorded.
+MFU_FLOOR = 0.37
+HEADLINE_GUARDS = True      # cleared by variant CLI flags in __main__
 
 BATCH = 128
 STEPS = 200     # one scan dispatch; long enough to amortize the final host
@@ -320,6 +336,8 @@ def main(legacy: bool = False) -> None:
     kind = getattr(dev, "device_kind", "unknown")
     peak = peak_tflops(kind)
     tflops = flops_step * STEPS / elapsed / 1e12
+    from znicz_tpu.core.config import root as _root
+
     print(json.dumps({
         "metric": ("alexnet_imagenet_train_throughput_legacy_r1_protocol"
                    if legacy else
@@ -338,10 +356,30 @@ def main(legacy: bool = False) -> None:
         "platform": getattr(dev, "platform", "unknown"),
         "peak_tflops_bf16": peak,
         "mfu_vs_peak": round(tflops / peak, 4) if peak else None,
+        "mfu_floor": MFU_FLOOR if (peak and not legacy and HEADLINE_GUARDS)
+        else None,
+        "fused_elementwise": bool(
+            _root.common.engine.get("fused_elementwise", False)),
         "loss_untrained": round(warmup_losses[0], 4),
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
     }))
+    # VERDICT r5 item 7 floors, enforced AFTER the JSON line so a tripped
+    # guard never destroys the measurement record it complains about (the
+    # protocol explicitly wants negatives recorded), and via raise (not
+    # assert) so ``python -O`` cannot strip the gate.
+    if not trainer.compute_confusion:
+        raise SystemExit(
+            "confusion accumulation must stay ON in the bench protocol "
+            "(the fused path sums it on device — bench.py measures that "
+            "cost)")
+    if peak and not legacy and HEADLINE_GUARDS:
+        mfu = tflops / peak
+        if mfu < MFU_FLOOR:
+            raise SystemExit(
+                f"headline MFU {mfu:.4f} fell below the {MFU_FLOOR} floor "
+                f"on {kind} — a silent perf regression; investigate "
+                "before re-recording (BASELINE.md ratchet)")
 
 
 #: --product: min seconds between on-best snapshot saves (see the inline
@@ -766,6 +804,7 @@ if __name__ == "__main__":
         # over more images (VERDICT r3 item 3c)
         BATCH = int(args[args.index("--batch") + 1])
         STEPS = max(1, (200 * 128) // BATCH)    # same images per window
+        HEADLINE_GUARDS = False
     if "--master-bf16" in args:
         # labeled VARIANT: bf16-STORED master weights (f32 update math) —
         # halves the per-step param read+write traffic but changes
@@ -773,6 +812,17 @@ if __name__ == "__main__":
         from znicz_tpu.core.config import root as _r
 
         _r.common.engine.master_dtype = "bfloat16"
+        HEADLINE_GUARDS = False
+    if "--fused-elementwise" in args:
+        # labeled VARIANT until BASELINE.md records the with/without
+        # numbers: route the conv1/conv2 LRN+ReLU+pool block through the
+        # single-pass Pallas kernel (znicz_tpu/pallas_fused_block.py).
+        # Same protocol, same loss gates; the JSON line records the flag
+        # so with/without runs are directly comparable.
+        from znicz_tpu.core.config import root as _r
+
+        _r.common.engine.fused_elementwise = True
+        HEADLINE_GUARDS = False
     if "--samples" in args:
         measure_samples()
     elif "--stream" in args:

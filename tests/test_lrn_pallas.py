@@ -59,6 +59,35 @@ def test_pallas_lrn_flag_routes_unit(tmp_path):
     np.testing.assert_allclose(fast, base, rtol=1e-5, atol=1e-6)
 
 
+def test_fused_block_lrn_stage_matches_oracle():
+    """The single-pass conv-block kernel (pallas_fused_block) degenerates
+    to relu -> LRN under a 1x1/s1 identity pool — its LRN stage must match
+    the same oracle the standalone Pallas LRN kernel is held to, forward
+    AND gradient (the fused bwd's closed-form LRN term)."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.pallas_fused_block import fused_block
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(2, 6, 6, 96)).astype(np.float32) * 2)
+    b = jnp.zeros((96,), jnp.float32)
+
+    def oracle(t):
+        return _jnp_lrn(jnp.maximum(t, 0.0))
+
+    y = fused_block(x, b, 5, 1e-4, 0.75, 2.0, (1, 1, 1, 1))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(oracle(x)),
+                               rtol=1e-5, atol=1e-6)
+
+    cot = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+    g = jax.grad(lambda t: jnp.sum(
+        fused_block(t, b, 5, 1e-4, 0.75, 2.0, (1, 1, 1, 1)) * cot))(x)
+    g_ref = jax.grad(lambda t: jnp.sum(oracle(t) * cot))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-6)
+
+
 def test_pallas_lrn_odd_channel_and_row_counts():
     """Row padding (rows not a multiple of TILE_R) and non-128 channel
     widths round-trip correctly."""

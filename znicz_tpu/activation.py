@@ -35,6 +35,15 @@ class ActivationBackward(GradientDescentBase):
                          **kwargs)
 
 
+def is_strict_relu_unit(unit) -> bool:
+    """True for a parameter-free standalone StrictRELU activation unit —
+    the recognizer the fused conv-block matcher uses to absorb a
+    Conv -> StrictRELU pair into the single-pass kernel
+    (znicz_tpu/pallas_fused_block.py)."""
+    return (isinstance(unit, ActivationForward)
+            and type(unit).ACTIVATION is activations.strict_relu)
+
+
 def _make(name, fn):
     fwd = type(f"Forward{name}", (ActivationForward,),
                {"ACTIVATION": staticmethod(fn)})

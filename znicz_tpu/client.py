@@ -172,7 +172,8 @@ class FusedClient(Client):
     def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
                  slave_id: Optional[str] = None):
         super().__init__(workflow, endpoint=endpoint, slave_id=slave_id)
-        from znicz_tpu.parallel.fused import FusedTrainer
+        from znicz_tpu.parallel.fused import (FusedStagingUnsupportedError,
+                                              FusedTrainer)
 
         # construct EAGERLY so an unsupported graph (tied weights, ...)
         # raises FusedUnsupportedError here — where the launcher can fall
@@ -180,7 +181,11 @@ class FusedClient(Client):
         # first job (compilation still happens lazily, per job shape)
         self._trainer = FusedTrainer(workflow)
         if self._trainer.staging:
-            raise ValueError(
+            # dedicated type: the engine's slave fallback catches exactly
+            # the known refusals, so a real config error (a bare
+            # ValueError) propagates instead of silently dropping to the
+            # unit-engine slave
+            raise FusedStagingUnsupportedError(
                 "FusedClient needs a device-resident loader "
                 "(host-staged streaming slaves are not supported)")
         self._velocities = None

@@ -51,7 +51,11 @@ class Conv(ForwardBase):
                                 self.padding)
         return (b, oh, ow, self.n_kernels)
 
-    def apply(self, params, x):
+    def apply_linear(self, params, x):
+        """The convolution alone — no bias, no activation.  The fused
+        conv-block path (pallas_fused_block) composes this with its own
+        single-pass bias+ReLU+LRN+pool kernel; ``apply`` composes it with
+        the unit's bias/activation.  One home for the conv math."""
         import jax.lax as lax
 
         w = params["weights"]                       # (K, ky, kx, C)
@@ -60,12 +64,15 @@ class Conv(ForwardBase):
         # bf16 output (MXU accumulates f32 internally) so vjp cotangent
         # dtypes stay consistent in mixed precision
         pref = np.float32 if x.dtype == np.float32 else None
-        y = lax.conv_general_dilated(
+        return lax.conv_general_dilated(
             x, jnp_transpose_hwio(w),
             window_strides=self.sliding,
             padding=((top, bottom), (left, right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=pref)
+
+    def apply(self, params, x):
+        y = self.apply_linear(params, x)
         if self.include_bias:
             y = y + params["bias"]
         return type(self).ACTIVATION(y)

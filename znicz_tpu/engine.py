@@ -61,13 +61,17 @@ def train(workflow) -> None:
         # compiled segment per job) instead of unit-graph laps; protocol
         # unchanged (VERDICT r4 item 5).  Graphs the fused engine cannot
         # run fall back to the unit Client, mirroring the local --fused
-        # fallback below.
+        # fallback below.  Catch ONLY the dedicated refusal types
+        # (FusedUnsupportedError covers the tied-weights refusal and the
+        # host-staged-loader subclass FusedStagingUnsupportedError) — a
+        # bare ValueError is a real config error and must propagate, not
+        # silently demote the slave to the slow unit engine.
         if _fused_capable(workflow):
             from znicz_tpu.parallel.fused import FusedUnsupportedError
 
             try:
                 client = FusedClient(workflow, endpoint=endpoint)
-            except (FusedUnsupportedError, ValueError) as exc:
+            except FusedUnsupportedError as exc:
                 import logging
 
                 logging.getLogger("znicz").warning(

@@ -27,9 +27,21 @@ class GDAvgPooling(GDPooling):
 
 class GDMaxPoolingBase(GDPooling):
     """Scatter err_output to the forward-recorded offsets (shared geometry
-    lives on PoolingBase.scatter_at_offsets)."""
+    lives on PoolingBase.scatter_at_offsets).
+
+    NOTE: on the fused engine with ``root.common.engine.fused_elementwise``
+    the conv1/conv2 pool backward runs inside the fused block kernel's
+    custom vjp (pallas_fused_block) — this unit is bypassed there along
+    with LRNormalizerBackward and the conv GD's activation term.  Offsets
+    exist only where a forward ``run()`` recorded them (the unit path)."""
 
     def run(self):
+        if not self.forward.input_offset:
+            raise RuntimeError(
+                f"{self.name}: the paired forward recorded no pooling "
+                "offsets — run the forward unit first (offsets are unit-"
+                "path state; the fused engine's pool backward never "
+                "materializes them)")
         if self._compiled is None:
             import jax
             self._compiled = jax.jit(self.forward.scatter_at_offsets)

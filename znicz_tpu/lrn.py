@@ -54,15 +54,14 @@ def _inv_pow(s, beta: float):
     change must be defensible side-by-side, not just re-recorded).
     Read at trace time: flip it only before the first compile of a
     process (the bench's --samples comparison uses subprocesses)."""
-    import jax
     import jax.numpy as jnp
 
     from znicz_tpu.core.config import root
+    from znicz_tpu.ops.lrn_pallas import inv_pow_rsqrt
 
     if beta == 0.75 and not bool(root.common.engine.get("lrn_pow",
                                                         False)):
-        r2 = jax.lax.rsqrt(s)
-        return r2 * jnp.sqrt(r2)
+        return inv_pow_rsqrt(s, beta)
     return jnp.power(s, -beta)
 
 
@@ -108,6 +107,16 @@ class LRNormalizerForward(ForwardBase):
 
     def output_shape_for(self, in_shape):
         return tuple(in_shape)
+
+    @property
+    def fused_block_hypers(self):
+        """(n, alpha, beta, k) when this unit's config is expressible by
+        the single-pass conv-block kernel (odd windows only — the kernel
+        shares the closed-form vjp's self-adjoint-window assumption), else
+        None.  Consumed by pallas_fused_block.match_fused_block."""
+        if self.n % 2 == 1:
+            return (self.n, self.alpha, self.beta, self.k)
+        return None
 
     def apply(self, params, x):
         from znicz_tpu.core.config import root

@@ -77,6 +77,15 @@ class Array:
         return self._mem is not None or self._devmem is not None
 
     @property
+    def host_dirty(self) -> bool:
+        """True when the host buffer holds writes not yet synced to the
+        device copy.  Raw-state peek (no sync) — consumers that hand the
+        device buffer onward (e.g. the fused trainer's cross-host-sharded
+        operand path, which CANNOT reshard implicitly) use this to refuse
+        stale reads instead of training on outdated state."""
+        return self._state == _HOST_DIRTY
+
+    @property
     def cross_host_sharded(self) -> bool:
         """True when the backing device buffer is a global array actually
         SHARDED across processes (not fully addressable, not fully

@@ -31,28 +31,48 @@ import numpy as np
 TILE_R = 1024          # rows per grid step (multiple of 8 for f32 tiling)
 
 
-def _windowed(sq, n):
-    """sum over the n-channel window centered at c (zero-padded ends),
-    unrolled with static shifts — identical summation order to the jnp
-    oracle in znicz_tpu/lrn.py."""
+def windowed_channel_sum(t, n):
+    """Sum over the n-channel window centered on the LAST axis (zero-padded
+    ends), unrolled with static shifts — identical summation order to the
+    jnp oracle in znicz_tpu/lrn.py.  Rank-general; the ONE home of the
+    shift-unrolled window sum, shared by this kernel and the fused
+    conv-block kernel (znicz_tpu/pallas_fused_block.py) whose parity
+    guarantees depend on this exact order."""
     import jax.numpy as jnp
 
     half = n // 2
-    C = sq.shape[-1]
     acc = None
     for j in range(n):
-        o = j - half                    # offset: acc_c += sq_{c+o}
+        o = j - half                    # offset: acc_c += t_{c+o}
         if o == 0:
-            part = sq
+            part = t
         elif o > 0:
             part = jnp.concatenate(
-                [sq[:, o:], jnp.zeros((sq.shape[0], o), sq.dtype)], axis=1)
+                [t[..., o:], jnp.zeros(t.shape[:-1] + (o,), t.dtype)],
+                axis=-1)
         else:
             part = jnp.concatenate(
-                [jnp.zeros((sq.shape[0], -o), sq.dtype), sq[:, :o]],
-                axis=1)
+                [jnp.zeros(t.shape[:-1] + (-o,), t.dtype), t[..., :o]],
+                axis=-1)
         acc = part if acc is None else acc + part
     return acc
+
+
+_windowed = windowed_channel_sum
+
+
+def inv_pow_rsqrt(s, beta: float):
+    """``s ** -beta`` via ``rsqrt(s)*sqrt(rsqrt(s))`` for the reference
+    default beta=0.75 (two pipelined VPU ops instead of the exp/log
+    ``pow`` expansion); plain ``pow`` otherwise.  Shared by lrn.py's jnp
+    path (its config-gated wrapper) and the fused conv-block kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    if beta == 0.75:
+        r = jax.lax.rsqrt(s)
+        return r * jnp.sqrt(r)
+    return jnp.power(s, -beta)
 
 
 def _fwd_kernel(n, alpha, beta, k, x_ref, y_ref):

@@ -1,0 +1,358 @@
+"""Single-pass fused Pallas kernel for the conv1/conv2 elementwise block:
+bias-add -> StrictRELU -> cross-channel LRN -> overlapping maxpool, forward
+AND backward, each as ONE VMEM-resident pass over the activation planes.
+
+Why (BASELINE.md r4 profile / VERDICT r5 weak #1): the composed ops lower to
+several XLA fusions that each stream the 55x55x96-class conv1/conv2 tensors
+through HBM — 4.39 ms of the 10.75 ms AlexNet step at a measured
+320–490 GB/s against the chip's 819, and the one lever behind three rounds
+of flat ~39.5% MFU.  The r5 masked-pool-backward experiment proved that
+MULTI-pass reformulations lose (more passes, more HBM traffic); this kernel
+is the single-pass counterpart: the forward reads x once and writes the
+pooled output once; the backward reads (x, bias, d_pool) once and writes
+(dx, dbias) once, with every intermediate (ReLU mask, LRN window sums, pool
+argmax masks) living only in VMEM.
+
+Grid: one image per grid step — a (1, H, W, C) block is VMEM-resident
+(conv1: 55*55*96*4 B = 1.2 MB f32).  The channel-window sum is unrolled
+static lane shifts (identical summation order to ops/lrn_pallas.py); the
+pool is unrolled ky*kx strided max/compare; the pool backward re-dilates
+window contributions with interior padding (lax.pad) — the same formulation
+``pooling._masked_maxpool`` uses, but fused in VMEM where its ~18
+intermediate tensors are free instead of 18 HBM round trips.
+
+Semantics vs the composed ops:
+  - forward is bit-for-tolerance identical (same rsqrt-based ``s^-0.75``,
+    same shift summation order as the LRN oracle);
+  - pool-backward TIES split d_y equally among a window's tied maxima
+    (mass-conserving) where select_and_scatter routes to the first.  After
+    StrictRELU the only systematic ties are all-zero windows, whose
+    gradient the ReLU mask zeroes either way, so the two subgradients agree
+    everywhere it matters (tests assert parity on random data);
+  - internal arithmetic is f32 even for bf16 operands (outputs cast back),
+    at least as accurate as the composed bf16 chain.
+
+Engagement (``plan_fused_blocks``): opt-in via
+``root.common.engine.fused_elementwise`` (default OFF until a TPU-attached
+bench records the with/without numbers — BASELINE.md "Fused elementwise
+block"), and only where the graph shape matches exactly:
+Conv(+bias)+StrictRELU (fused or as a standalone activation unit) ->
+LRNormalizerForward (odd window) -> MaxPooling whose windows tile the plane
+exactly (AlexNet's 55/27/13 planes all do; partial edge windows fall back
+to the composed ops).  The LRN-formulation experiment knobs
+(``lrn_pow`` / ``lrn_autodiff`` / ``pallas_lrn``) disable fusion so their
+side-by-side re-runs stay pure.
+
+Backward wiring: ``fused_block`` carries a ``jax.custom_vjp``, so wherever
+the fused trainer's forward_pass routes through it, ``jax.grad`` of the
+train step executes the fused backward kernel in place of the
+``GradientDescent*`` chain (GDStrictRELUConv's activation term,
+LRNormalizerBackward, GDMaxPooling's offset scatter).  The unit-at-a-time
+engine keeps the composed units — it cannot fuse across unit boundaries by
+construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FusedBlockSpec(NamedTuple):
+    """One matched conv-block occurrence in a forwards list."""
+
+    span: int                      # units consumed (3, or 4 with a
+    #                                standalone StrictRELU unit)
+    n: int                         # LRN channel window
+    alpha: float
+    beta: float
+    k: float
+    pool: Tuple[int, int, int, int]   # (ky, kx, sy, sx)
+
+
+def _use_interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _relu_lrn(x, b, n, alpha, beta, k):
+    """The pre-pool part shared by both kernels: f32 a/mask/r/s/y.  The
+    window sum and ``s^-beta`` come from ops/lrn_pallas — the ONE home of
+    that order-sensitive math (the parity guarantees depend on the exact
+    summation order and rsqrt formulation)."""
+    import jax.numpy as jnp
+
+    from znicz_tpu.ops.lrn_pallas import (inv_pow_rsqrt,
+                                          windowed_channel_sum)
+
+    a = x + b
+    r = jnp.maximum(a, 0.0)
+    s = k + alpha * windowed_channel_sum(r * r, n)
+    return a, r, s, r * inv_pow_rsqrt(s, beta)
+
+
+def _pool_windows(y, ky, kx, sy, sx, oh, ow):
+    """ky*kx strided (OH, OW, C) window views of an exactly-tiling plane."""
+    from jax import lax
+
+    C = y.shape[-1]
+    wins = []
+    for i in range(ky):
+        for j in range(kx):
+            wins.append(lax.slice(
+                y, (i, j, 0),
+                (i + (oh - 1) * sy + 1, j + (ow - 1) * sx + 1, C),
+                (sy, sx, 1)))
+    return wins
+
+
+def _fwd_kernel(n, alpha, beta, k, ky, kx, sy, sx, x_ref, b_ref, out_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    _, _, _, y = _relu_lrn(x, b, n, alpha, beta, k)
+    oh, ow = out_ref.shape[1], out_ref.shape[2]
+    p = None
+    for win in _pool_windows(y, ky, kx, sy, sx, oh, ow):
+        p = win if p is None else jnp.maximum(p, win)
+    out_ref[0] = p.astype(out_ref.dtype)
+
+
+def _bwd_kernel(n, alpha, beta, k, ky, kx, sy, sx,
+                x_ref, b_ref, dp_ref, dx_ref, db_ref):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    from znicz_tpu.ops.lrn_pallas import (inv_pow_rsqrt,
+                                          windowed_channel_sum)
+
+    x = x_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    dp = dp_ref[0].astype(jnp.float32)
+    a, r, s, y = _relu_lrn(x, b, n, alpha, beta, k)
+    sb = inv_pow_rsqrt(s, beta)
+    H, W, _ = y.shape
+    oh, ow = dp.shape[0], dp.shape[1]
+    # pool backward: recompute window maxima, split dp among ties
+    # (mass-conserving; see module docstring for the tie semantics)
+    wins = _pool_windows(y, ky, kx, sy, sx, oh, ow)
+    p = None
+    for win in wins:
+        p = win if p is None else jnp.maximum(p, win)
+    masks, nt = [], None
+    for win in wins:
+        mk = (win == p).astype(jnp.float32)
+        masks.append(mk)
+        nt = mk if nt is None else nt + mk
+    g = dp / nt
+    dy, mi = None, 0
+    for i in range(ky):
+        for j in range(kx):
+            contrib = g * masks[mi]
+            mi += 1
+            # interior padding re-dilates the strided window back to
+            # plane coordinates — pure pad, no scatter, all in VMEM
+            part = lax.pad(
+                contrib, jnp.zeros((), jnp.float32),
+                ((i, H - (i + (oh - 1) * sy + 1), sy - 1),
+                 (j, W - (j + (ow - 1) * sx + 1), sx - 1),
+                 (0, 0, 0)))
+            dy = part if dy is None else dy + part
+    # LRN backward — the closed form from znicz_tpu/lrn.py:
+    #   dr = dy*s^-beta - 2*alpha*beta * r * W(dy * r * s^(-beta-1))
+    t = dy * r * (sb / s)
+    dr = dy * sb - (2.0 * alpha * beta) * r * windowed_channel_sum(t, n)
+    # StrictRELU mask + bias reduction
+    da = dr * (a > 0.0).astype(jnp.float32)
+    dx_ref[0] = da.astype(dx_ref.dtype)
+    partial = jnp.sum(da, axis=(0, 1))
+    bi = pl.program_id(0)
+
+    @pl.when(bi == 0)
+    def _():
+        db_ref[0] = partial
+
+    @pl.when(bi > 0)
+    def _():
+        db_ref[0] = db_ref[0] + partial
+
+
+#: generous VMEM cap: the backward holds ~20 plane-sized intermediates
+#: live before Mosaic's buffer reuse (conv1 plane ~1.2 MB f32)
+_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def _pool_out_hw(h, w, ky, kx, sy, sx):
+    return (h - ky) // sy + 1, (w - kx) // sx + 1
+
+
+def _img_spec(shape):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec((1,) + tuple(shape[1:]),
+                        lambda bi: (bi, 0, 0, 0), memory_space=pltpu.VMEM)
+
+
+def _bias_spec(c):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec((1, c), lambda bi: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _call_fwd(x, bias, n, alpha, beta, k, pool):
+    import jax
+    from jax.experimental import pallas as pl
+
+    ky, kx, sy, sx = pool
+    B, H, W, C = x.shape
+    oh, ow = _pool_out_hw(H, W, ky, kx, sy, sx)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, n, alpha, beta, k, ky, kx, sy, sx),
+        grid=(B,),
+        in_specs=[_img_spec(x.shape), _bias_spec(C)],
+        out_specs=_img_spec((B, oh, ow, C)),
+        out_shape=jax.ShapeDtypeStruct((B, oh, ow, C), x.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_use_interpret(),
+    )(x, bias.reshape(1, C))
+
+
+def _call_bwd(x, bias, dp, n, alpha, beta, k, pool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ky, kx, sy, sx = pool
+    B, H, W, C = x.shape
+    dx, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, n, alpha, beta, k, ky, kx, sy, sx),
+        grid=(B,),
+        in_specs=[_img_spec(x.shape), _bias_spec(C),
+                  _img_spec(dp.shape)],
+        out_specs=(_img_spec(x.shape), _bias_spec(C)),
+        out_shape=(jax.ShapeDtypeStruct((B, H, W, C), x.dtype),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32)),
+        compiler_params=_compiler_params(),
+        interpret=_use_interpret(),
+    )(x, bias.reshape(1, C), dp)
+    return dx, db.reshape(bias.shape).astype(bias.dtype)
+
+
+def _make():
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+    def fused_block(x, bias, n, alpha, beta, k, pool):
+        return _call_fwd(x, bias, n, alpha, beta, k, pool)
+
+    def fwd(x, bias, n, alpha, beta, k, pool):
+        # residual is (x, bias) only — everything else is recomputed in
+        # VMEM by the backward kernel (same policy as lrn.py's closed vjp)
+        return fused_block(x, bias, n, alpha, beta, k, pool), (x, bias)
+
+    def bwd(n, alpha, beta, k, pool, res, dp):
+        x, bias = res
+        return _call_bwd(x, bias, dp, n, alpha, beta, k, pool)
+
+    fused_block.defvjp(fwd, bwd)
+    return fused_block
+
+
+_fused = None
+
+
+def fused_block(x, bias, n=5, alpha=1e-4, beta=0.75, k=2.0,
+                pool=(3, 3, 2, 2)):
+    """Fused bias+StrictRELU+LRN+maxpool with the fused backward as its
+    custom vjp.  ``x`` is the RAW conv output (``Conv.apply_linear``) of
+    shape (B, H, W, C); ``pool`` = (ky, kx, sy, sx) and must tile (H, W)
+    exactly — ``plan_fused_blocks`` guarantees this."""
+    global _fused
+    if _fused is None:
+        _fused = _make()
+    ky, kx, sy, sx = (int(v) for v in pool)
+    _, H, W, _ = x.shape
+    assert (H - ky) % sy == 0 and (W - kx) % sx == 0, \
+        f"pool {pool} does not tile ({H}, {W}) exactly"
+    return _fused(x, bias, int(n), float(alpha), float(beta), float(k),
+                  (ky, kx, sy, sx))
+
+
+def match_fused_block(forwards: Sequence, i: int) -> Optional[FusedBlockSpec]:
+    """The FusedBlockSpec for a conv-block starting at ``forwards[i]``, or
+    None.  Patterns: ConvStrictRELU -> norm -> max_pooling (span 3), or
+    plain Conv -> StrictRELU activation unit -> norm -> max_pooling
+    (span 4).  Units must be initialized (geometry comes from live
+    shapes)."""
+    from znicz_tpu.activation import is_strict_relu_unit
+    from znicz_tpu.conv import Conv
+    from znicz_tpu.lrn import LRNormalizerForward
+    from znicz_tpu.ops import activations
+    from znicz_tpu.pooling import MaxPooling
+
+    conv = forwards[i]
+    if not isinstance(conv, Conv) or not conv.include_bias:
+        return None
+    j = i + 1
+    if conv.ACTIVATION is activations.strict_relu:
+        pass
+    elif conv.ACTIVATION is activations.identity and j < len(forwards) \
+            and is_strict_relu_unit(forwards[j]):
+        j += 1
+    else:
+        return None
+    if j + 1 >= len(forwards):
+        return None
+    lrn_u, pool_u = forwards[j], forwards[j + 1]
+    if not isinstance(lrn_u, LRNormalizerForward):
+        return None
+    hypers = lrn_u.fused_block_hypers
+    if hypers is None:
+        return None
+    # exact class: MaxAbs/stochastic/avg pooling have different math
+    if type(pool_u) is not MaxPooling or not pool_u.exact_tiling():
+        return None
+    n, alpha, beta, k = hypers
+    sy, sx = pool_u.sliding
+    return FusedBlockSpec(span=j + 2 - i, n=n, alpha=alpha, beta=beta,
+                          k=k, pool=(pool_u.ky, pool_u.kx, sy, sx))
+
+
+def plan_fused_blocks(forwards: Sequence) -> Dict[int, FusedBlockSpec]:
+    """start-index -> FusedBlockSpec for every fusable conv block, or {}
+    when the ``fused_elementwise`` flag is off / an LRN-formulation
+    experiment knob is active (their side-by-side re-runs must stay
+    pure — BASELINE.md anchor-defense protocol)."""
+    from znicz_tpu.core.config import root
+
+    eng = root.common.engine
+    if not bool(eng.get("fused_elementwise", False)):
+        return {}
+    if any(bool(eng.get(knob, False))
+           for knob in ("lrn_pow", "lrn_autodiff", "pallas_lrn")):
+        return {}
+    plan: Dict[int, FusedBlockSpec] = {}
+    i = 0
+    while i < len(forwards):
+        spec = match_fused_block(forwards, i)
+        if spec is not None:
+            plan[i] = spec
+            i += spec.span
+        else:
+            i += 1
+    return plan

@@ -57,6 +57,14 @@ class FusedUnsupportedError(ValueError):
     catch masked unrelated failures)."""
 
 
+class FusedStagingUnsupportedError(FusedUnsupportedError):
+    """A fused SLAVE cannot serve a host-staged streaming loader
+    (FusedClient needs the dataset device-resident).  A dedicated type so
+    the engine's slave fallback catches exactly the two known refusals —
+    this and the base FusedUnsupportedError — instead of a blanket
+    ``ValueError`` that would also swallow real config errors."""
+
+
 class FusedTrainer:
     """Compile and drive fused steps for a built+initialized workflow with
     ``forwards``, ``gds``, ``loader``, ``evaluator``, ``decision``."""
@@ -183,6 +191,17 @@ class FusedTrainer:
                 if arr.cross_host_sharded:
                     # devmem already spans hosts (e.g. restore_sharded
                     # placed it) — hand the global array straight through.
+                    # But only while it is CURRENT: a host write since
+                    # (map_write/map_invalidate) means the sharded buffer
+                    # is stale, and host collection cannot reshard a
+                    # cross-host Array implicitly — silently returning it
+                    # would train on outdated state.
+                    if arr.host_dirty:
+                        raise RuntimeError(
+                            "cross-host-sharded Array has a NEWER host "
+                            "copy than its device shards; re-distribute "
+                            "it explicitly (global_put / restore_sharded) "
+                            "before extracting fused-step state")
                     # A DELETED buffer (donated into a prior step) must
                     # not fall through here: it would surface later as a
                     # confusing "Array has been deleted" inside jit
@@ -355,17 +374,38 @@ class FusedTrainer:
         (LOGITS for a softmax last layer — loss and probs both derive from
         them, matching the evaluator's math).  ``cast`` re-casts activations
         between layers in mixed precision (matmul/conv accumulate f32 via
-        preferred_element_type, outputs drop back to bf16)."""
+        preferred_element_type, outputs drop back to bf16).
+
+        With ``root.common.engine.fused_elementwise`` on, every matched
+        conv1/conv2-style block (Conv+bias+StrictRELU -> LRN -> exactly-
+        tiling MaxPooling) runs as the raw conv plus ONE single-pass
+        Pallas kernel whose custom vjp is the fused backward — the graph
+        the GradientDescent* chain would otherwise differentiate op by op
+        (pallas_fused_block; plan computed per trace, shapes unchanged)."""
         import jax
 
         from znicz_tpu.ops.linear import linear
+        from znicz_tpu.pallas_fused_block import fused_block, \
+            plan_fused_blocks
 
+        plan = plan_fused_blocks(self.forwards)
         h = x
         last = self.forwards[-1]
-        for i, f in enumerate(self.forwards):
+        i = 0
+        while i < len(self.forwards):
+            f = self.forwards[i]
             if cast is not None:
                 h = cast(h)
             p = params.get(f.name, {})
+            blk = plan.get(i)
+            if blk is not None:
+                h = f.apply_linear(p, h)
+                h = fused_block(h, p["bias"], blk.n, blk.alpha, blk.beta,
+                                blk.k, blk.pool)
+                # dropout/stochpool never sit inside a fused block, so
+                # later units keep their own fold_in(key, i) indices
+                i += blk.span
+                continue
             if isinstance(f, self._dropout_cls):
                 if train:
                     k = jax.random.fold_in(key, i)
@@ -385,6 +425,7 @@ class FusedTrainer:
                 h = h.reshape((x.shape[0],) + f.output_sample_shape)
             else:
                 h = f.apply(p, h)
+            i += 1
         return h
 
     def loss_and_metrics(self, params, data, target, batch_size, key,

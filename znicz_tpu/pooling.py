@@ -65,6 +65,16 @@ class PoolingBase(ForwardBase):
         pw = (ow - 1) * sx + self.kx
         return (int(b), int(h), int(w), int(c), oh, ow, sy, sx, ph, pw)
 
+    def exact_tiling(self) -> bool:
+        """True when every pooling window is full — the padded extent the
+        windows cover equals the input plane, so no partial edge windows
+        exist.  Geometry precondition of the single-pass fused conv-block
+        kernel (pallas_fused_block): AlexNet's 55/27/13 planes with 3x3/s2
+        overlapping pools all tile exactly; anything else falls back to
+        the composed ops."""
+        _, h, w, c, oh, ow, sy, sx, ph, pw = self._window_geometry()
+        return ph == h and pw == w
+
     def windows(self, x):
         """(B, OH, OW, C, ky*kx) view of all pooling windows.  Spatial
         geometry is the unit's static config; the batch dim follows ``x``

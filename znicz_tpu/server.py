@@ -60,6 +60,7 @@ class Server:
         self.jobs_done = 0
         self.jobs_requeued = 0
         self.stale_updates = 0
+        self.bad_updates = 0            # malformed replies refused+requeued
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
@@ -118,6 +119,11 @@ class Server:
 
     def _tail_outstanding(self) -> bool:
         return any(j.get("last_minibatch") for j in self._outstanding())
+
+    #: malformed replies tolerated per segment job before it is dropped
+    #: instead of re-queued (bounds the refuse/refetch livelock a
+    #: deterministically-broken slave would otherwise spin)
+    MAX_BAD_REPLIES = 3
 
     #: reply sentinel: no job RIGHT NOW (epoch-boundary ordering), ask
     #: again — distinct from None (training done)
@@ -264,6 +270,36 @@ class Server:
                 self.stale_updates += 1
                 return {"ok": False, "stale": True}
             job, _, _ = entry
+            if "minibatches" in job:
+                # a segment reply must carry one metrics dict PER
+                # minibatch — a short (or long) list means the slave ran
+                # a different job than assigned, and zip() would silently
+                # truncate the feed; refuse the whole update (deltas
+                # included — they came from the same broken run) and
+                # re-queue the job so the work is not lost.  Bounded: a
+                # deterministically-broken slave (version skew) would
+                # otherwise refetch and re-fail the same job forever —
+                # after MAX_BAD_REPLIES the non-tail segment is dropped
+                # (its metrics are lost like a stale update's; Decision
+                # control flow never depends on non-tail feeds).
+                ms = req.get("metrics") or []
+                if len(ms) != len(job["minibatches"]):
+                    import logging
+
+                    self.bad_updates += 1
+                    job["_bad_replies"] = job.get("_bad_replies", 0) + 1
+                    requeue = job["_bad_replies"] < self.MAX_BAD_REPLIES
+                    logging.getLogger("znicz").warning(
+                        "slave %s: segment update carries %d metrics for "
+                        "%d minibatches — refusing the update and %s",
+                        sid, len(ms), len(job["minibatches"]),
+                        "re-queueing the job" if requeue else
+                        "DROPPING the job (repeated malformed replies)")
+                    if requeue:
+                        self._pending.append(job)
+                    return {"ok": False,
+                            "error": f"segment metrics length {len(ms)} "
+                                     f"!= {len(job['minibatches'])}"}
             if req.get("deltas"):
                 self.apply_deltas(req["deltas"])
             # async arrivals after completion must not rewind decision state
