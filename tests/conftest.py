@@ -11,6 +11,12 @@ provision_cpu_devices(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak tests excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _fixed_seed():
     """Every test starts from the same global seed and a clean stream table."""

@@ -1,0 +1,683 @@
+"""Fault-tolerance chaos suite (ISSUE 2): seeded frame chaos through a
+ROUTER/DEALER proxy, slave kill mid-job, master kill + crash-resume,
+delta quarantine, bad-frame refusal, dead-slave eviction, and the client
+reconnect state machine — all CPU-only, in-process, and seeded so CI
+reruns see identical fault schedules."""
+
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+#: the suite's fault mix — seed 5 gives every fault type >= 3 hits in
+#: the first 120 frames (see test_fault_schedule_deterministic)
+CHAOS = dict(drop=0.06, corrupt=0.06, duplicate=0.05, delay=0.08,
+             delay_s=(0.02, 0.25))
+SEED = 5
+
+
+def _make_workflow(tmp_path, max_epochs=3):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 300
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = max_epochs
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def _handshake_fields(workflow):
+    from znicz_tpu.network_common import handshake_request
+
+    msg = handshake_request(workflow)
+    del msg["cmd"]
+    return msg
+
+
+# -- the fault schedule --------------------------------------------------------
+
+
+def test_fault_schedule_deterministic():
+    """Two chaos runs with the same seed produce IDENTICAL fault
+    schedules: decide(i) is a pure function of (seed, i) — thread timing
+    and traffic volume cannot perturb it (the CI determinism contract)."""
+    from znicz_tpu.parallel.chaos import FaultSchedule
+
+    a = FaultSchedule(SEED, **CHAOS)
+    b = FaultSchedule(SEED, **CHAOS)
+    assert a.decisions(500) == b.decisions(500)
+    # a different seed really is a different schedule
+    c = FaultSchedule(SEED + 1, **CHAOS)
+    assert a.decisions(500) != c.decisions(500)
+    # the suite's seed exercises every fault type early
+    from collections import Counter
+
+    counts = Counter(action for action, _ in a.decisions(120))
+    for action in ("drop", "corrupt", "dup", "delay", "forward"):
+        assert counts[action] >= 3, counts
+    # probabilities must stay a sub-distribution
+    with pytest.raises(ValueError, match="sum"):
+        FaultSchedule(1, drop=0.7, corrupt=0.4)
+
+
+def test_corrupt_payload_is_undecodable():
+    from znicz_tpu.parallel.chaos import corrupt_payload
+
+    payload = pickle.dumps({"cmd": "job", "id": "s1"})
+    mangled = corrupt_payload(payload)
+    assert mangled != payload
+    with pytest.raises(Exception):
+        pickle.loads(mangled)
+
+
+# -- frame chaos through the proxy ---------------------------------------------
+
+
+def test_chaos_proxy_faults_accounted(tmp_path):
+    """The acceptance run: seeded drop/corrupt/duplicate/delay between
+    two slaves and the master.  Training completes without hang or
+    crash, converges to the fault-free quality band, and every injected
+    fault is accounted for: corrupted requests == the master's
+    bad_frames, corrupted replies == the slaves' bad_replies, and every
+    starved receive (drops + corrupted replies) shows up as a client
+    reconnect."""
+    from znicz_tpu.client import Client
+    from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
+    from znicz_tpu.server import Server
+
+    front = "tcp://127.0.0.1:17580"      # slaves connect here
+    back = "tcp://127.0.0.1:17581"       # master binds here
+    proxy = ChaosProxy(front, back,
+                       FaultSchedule(SEED, **CHAOS)).start()
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, endpoint=back, job_timeout=6.0)
+
+    slaves = [Client(_make_workflow(tmp_path / f"s{i}"), endpoint=front,
+                     slave_id=f"chaos{i}") for i in range(2)]
+    errors = []
+
+    def worker(s):
+        try:
+            s.run(recv_timeout=1.0, max_reconnects=40, backoff_base=0.05,
+                  backoff_cap=0.4, connect_retries=40)
+        except BaseException as e:
+            errors.append((s.slave_id, repr(e)))
+            raise
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in slaves]
+    try:
+        for t in threads:
+            t.start()
+        server.serve(linger=8.0)
+        for t in threads:
+            t.join(timeout=90)
+    finally:
+        proxy.stop()
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+
+    dec = master_wf.decision
+    assert bool(dec.complete)            # no hang, no crash
+    valid = dec.epoch_metrics[1]
+    assert valid is not None and valid["err_pct"] < 70.0, valid
+
+    # -- fault accounting: nothing injected was lost silently ----------
+    c = proxy.counters
+    assert len(proxy.log) == sum(n for d in c.values() for n in d.values())
+    assert proxy.total_faults() > 0
+    for action in ("drop", "corrupt", "dup", "delay"):
+        assert c["req"][action] + c["rep"][action] > 0, c
+    # every corrupted request was refused + counted by the master
+    assert server.bad_frames == c["req"]["corrupt"], (server.bad_frames, c)
+    # every corrupted reply was detected + counted by a slave.  A dup
+    # spawns one EXTRA reply the client's REQ_CORRELATE discards unseen;
+    # a later drop/corrupt decision can land on that ghost frame, so the
+    # client-side counters may undercount by at most the dup count.
+    dups = c["req"]["dup"] + c["rep"]["dup"]
+    bad_replies = sum(s.bad_replies for s in slaves)
+    assert c["rep"]["corrupt"] - dups <= bad_replies <= c["rep"]["corrupt"]
+    # every starved receive became a reconnect (fresh socket + backoff);
+    # slack below for ghost-frame absorption, above for endgame retries
+    # after the master's linger expires
+    starved = proxy.faults_toward("rep")
+    reconnects = sum(s.reconnects for s in slaves)
+    assert starved - dups <= reconnects <= starved + 3 * len(slaves), \
+        (starved, reconnects, c)
+    # books balance: every accepted update is attributed to a slave
+    assert server.jobs_done == sum(server.jobs_by_slave.values())
+    assert all(server.jobs_by_slave.get(s.slave_id, 0) > 0 for s in slaves)
+
+
+# -- slave kill + master kill/resume -------------------------------------------
+
+
+def test_slave_kill_and_master_crash_resume(tmp_path):
+    """Mid-job slave death AND a master kill+restart mid-epoch: the
+    restarted master restores the periodic crash-resume snapshot
+    (params, loader/decision cursors, outstanding jobs, counters), the
+    slaves ride the outage out via reconnect/backoff and re-register,
+    and training completes in the fault-free quality band."""
+    from znicz_tpu.client import Client
+    from znicz_tpu.parallel.chaos import MasterHarness, take_job_and_die
+
+    endpoint = "tcp://127.0.0.1:17582"
+    resume = str(tmp_path / "master_resume.pickle.gz")
+    harness = MasterHarness(
+        lambda: _make_workflow(tmp_path / "m"), endpoint, resume,
+        snapshot_every_s=0.25, linger=5.0, job_timeout=8.0)
+    server1 = harness.start()
+    assert not server1.resumed           # nothing to resume from yet
+
+    slaves = [Client(_make_workflow(tmp_path / f"s{i}"), endpoint=endpoint,
+                     slave_id=f"phoenix{i}") for i in range(2)]
+    errors = []
+
+    def worker(s):
+        try:
+            s.run(recv_timeout=1.0, max_reconnects=60, backoff_base=0.05,
+                  backoff_cap=0.3)
+        except BaseException as e:
+            errors.append((s.slave_id, repr(e)))
+            raise
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in slaves]
+    for t in threads:
+        t.start()
+
+    # a slave takes a job and dies mid-job (same digest as the master)
+    doomed_jid = take_job_and_die(endpoint, harness.workflow, "doomed")
+    assert doomed_jid is not None
+
+    # let it make progress, then wait for a snapshot that has SEEN that
+    # progress (a save from before jobs_done crossed 3 would roll the
+    # counters back past the assertion below)
+    deadline = time.time() + 60
+    while server1.jobs_done < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    assert server1.jobs_done >= 3
+    saves = server1.resume_saves
+    while server1.resume_saves <= saves and time.time() < deadline:
+        time.sleep(0.05)
+    assert server1.resume_saves > saves
+    harness.kill()                       # simulated crash, mid-epoch
+    assert os.path.exists(resume)
+    # stay dark past the slaves' recv_timeout so the outage exercises
+    # the timeout->fresh-socket->backoff path, not just zmq's transparent
+    # redelivery into the instantly-rebound endpoint
+    time.sleep(1.5)
+
+    server2 = harness.start()            # restarts from the snapshot
+    assert server2.resumed
+    assert server2.jobs_done >= 3        # counters carried over
+    assert harness.wait(timeout=180)
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+
+    dec = harness.workflow.decision
+    assert bool(dec.complete)            # resumed run finished training
+    valid = dec.epoch_metrics[1]
+    assert valid is not None and valid["err_pct"] < 70.0, valid
+    # the slaves really rode the restart out via reconnect+re-register
+    assert sum(s.reconnects for s in slaves) >= 1
+    assert server2.reregistrations >= 1
+    # dead slave's job never reached an accepted update
+    assert server2.jobs_by_slave.get("doomed", 0) == 0
+    assert server2.jobs_done == sum(server2.jobs_by_slave.values())
+    # the resume file is consumed by a COMPLETED run — a rerun of the
+    # same command must start fresh, not restore stale mid-training state
+    assert not os.path.exists(resume)
+
+
+# -- delta quarantine ----------------------------------------------------------
+
+
+def test_quarantine_nonfinite_delta_never_applied(tmp_path):
+    """A NaN/Inf delta is refused (never touches global params), counted,
+    and the job is re-queued."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    jid = rep["job_id"]
+    before = {f.name: {k: np.array(a.map_read())
+                       for k, a in f.params().items()}
+              for f in master_wf.forwards if f.has_weights}
+    poisoned = {name: {k: np.full_like(v, np.nan)
+                       for k, v in layer.items()}
+                for name, layer in before.items()}
+    rep = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                          "deltas": poisoned,
+                          "metrics": {"loss": 0.0, "n_err": 0}})
+    assert rep["ok"] is False and rep.get("quarantined")
+    assert "non-finite" in rep["error"]
+    assert server.quarantined_updates == 1
+    assert len(server._pending) == 1     # the job came back
+    for f in master_wf.forwards:
+        if f.has_weights:
+            for k, a in f.params().items():
+                np.testing.assert_array_equal(np.array(a.map_read()),
+                                              before[f.name][k])
+
+
+def test_quarantine_norm_exploded_bounded_retry(tmp_path):
+    """A finite but norm-exploded delta (diverging slave) is quarantined
+    against the running median of accepted norms; the job follows the
+    bounded MAX_BAD_REPLIES policy — re-queued, then DROPPED after
+    repeated bad deltas so one broken slave cannot livelock the run.
+    Sane deltas keep flowing afterwards."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    server._delta_norms.extend([1.0, 1.1, 0.9, 1.0, 1.05])   # history
+
+    def update(jid, scale):
+        deltas = {f.name: {k: np.full(a.shape, scale, np.float32)
+                           for k, a in f.params().items()}
+                  for f in master_wf.forwards if f.has_weights}
+        return server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                               "deltas": deltas,
+                               "metrics": {"loss": 1.0, "n_err": 0}})
+
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    for attempt in range(server.MAX_BAD_REPLIES):
+        rep = update(jid, 1e6)           # norm >> 25 x median
+        assert rep["ok"] is False and rep.get("quarantined"), rep
+        assert "median" in rep["error"]
+        requeued = bool(server._pending)
+        if attempt < server.MAX_BAD_REPLIES - 1:
+            assert requeued              # bounded retry: back in the queue
+            rep = server._handle({"cmd": "job", "id": "s1"})
+            jid = rep["job_id"]
+        else:
+            assert not requeued          # ...then dropped for good
+    assert server.quarantined_updates == server.MAX_BAD_REPLIES
+    # a sane update on a fresh job is still accepted
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    rep = update(jid, 1e-4)
+    assert rep["ok"] is True
+    assert server.jobs_done == 1
+
+
+def test_malformed_update_payloads_never_lose_the_job(tmp_path):
+    """Post-pop safety: once an update's job has left _inflight, a
+    structurally-broken payload (metrics of the wrong type, ragged or
+    wrong-shape delta arrays) must refuse-and-requeue — an exception
+    there would lose the job silently (and hang the epoch if it was the
+    tail)."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    first = next(f for f in master_wf.forwards if f.has_weights)
+
+    # 1) singleton metrics that is a LIST (segment-style reply to a flat
+    # job) — previously raised in _feed_decision after the pop
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    rep = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                          "deltas": None, "metrics": [{"loss": 1.0}]})
+    assert rep["ok"] is False and "not a dict" in rep["error"]
+    assert server.bad_updates == 1
+    assert len(server._pending) == 1     # requeued, not lost
+
+    # 2) ragged delta array — np.asarray raises; must quarantine
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    rep = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                          "deltas": {first.name:
+                                     {"weights": [[1.0], [2.0, 3.0]]}},
+                          "metrics": {"loss": 1.0, "n_err": 0}})
+    assert rep["ok"] is False and rep.get("quarantined"), rep
+    assert "undecodable delta payload" in rep["error"]
+    assert len(server._pending) == 1
+
+    # 3) wrong-shape delta — apply_deltas would raise mid-apply
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    rep = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                          "deltas": {first.name:
+                                     {"weights": np.zeros((2, 2),
+                                                          np.float32)}},
+                          "metrics": {"loss": 1.0, "n_err": 0}})
+    assert rep["ok"] is False and rep.get("quarantined"), rep
+    assert "shape" in rep["error"]
+    assert server.quarantined_updates == 2
+    # third strike on the same (non-tail) job: the bounded policy drops
+    # it instead of re-queueing — no livelock
+    assert not server._pending
+
+    # the stream moves on and a sane update completes the next job
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    jid = rep["job_id"]
+    rep = server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                          "deltas": None,
+                          "metrics": {"loss": 1.0, "n_err": 0}})
+    assert rep["ok"] is True and server.jobs_done == 1
+
+
+# -- bad frames ----------------------------------------------------------------
+
+
+def test_bad_frame_refused_not_fatal(tmp_path):
+    """A garbage frame gets an error reply and a bad_frames tick instead
+    of raising out of the REP loop and killing the master; the next
+    well-formed request is served normally."""
+    import zmq
+
+    from znicz_tpu.server import Server
+
+    endpoint = "tcp://127.0.0.1:17583"
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, endpoint=endpoint)
+    thread = threading.Thread(target=server.serve, daemon=True)
+    thread.start()
+    sock = zmq.Context.instance().socket(zmq.REQ)
+    sock.setsockopt(zmq.RCVTIMEO, 10_000)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.connect(endpoint)
+    try:
+        sock.send(b"\x00 definitely not a pickle")
+        rep = pickle.loads(sock.recv())
+        assert rep["ok"] is False and rep.get("bad_frame")
+        # a frame that IS a pickle but not a request dict is refused too
+        sock.send(pickle.dumps([1, 2, 3]))
+        rep = pickle.loads(sock.recv())
+        assert rep["ok"] is False and rep.get("bad_frame")
+        assert server.bad_frames == 2
+        # the master still serves well-formed peers
+        msg = {"cmd": "register", "id": "s1",
+               **_handshake_fields(master_wf)}
+        sock.send(pickle.dumps(msg))
+        assert pickle.loads(sock.recv())["ok"]
+    finally:
+        sock.close(0)
+        server.stop()
+        thread.join(timeout=10)
+    assert not thread.is_alive()
+
+
+# -- the client reconnect state machine ----------------------------------------
+
+
+def test_client_reconnects_with_fresh_socket_after_timeout(tmp_path):
+    """The REQ EFSM fix: after a silent master (zmq.Again) the client
+    closes the dead socket, backs off, reconnects FRESH and re-registers
+    — previously any retry on the same socket raised ZMQError(EFSM)."""
+    import zmq
+
+    from znicz_tpu.client import Client
+
+    endpoint = "tcp://127.0.0.1:17584"
+    wf = _make_workflow(tmp_path / "s")
+    seen = []
+
+    def scripted_master():
+        """ROUTER-based master: replies to everything EXCEPT the first
+        job request, which it swallows (a dropped reply)."""
+        ctx = zmq.Context.instance()
+        router = ctx.socket(zmq.ROUTER)
+        router.setsockopt(zmq.RCVTIMEO, 20_000)
+        router.setsockopt(zmq.LINGER, 0)
+        router.bind(endpoint)
+        try:
+            ignored_job = False
+            while True:
+                frames = router.recv_multipart()
+                req = pickle.loads(frames[-1])
+                seen.append(req["cmd"])
+                if req["cmd"] == "job" and not ignored_job:
+                    ignored_job = True
+                    continue                    # swallow: client times out
+                if req["cmd"] == "register":
+                    rep = {"ok": True, "version": req["version"],
+                           "class_lengths": [0, 60, 300]}
+                elif req["cmd"] == "job":
+                    rep = {"done": True}
+                router.send_multipart(frames[:-1] + [pickle.dumps(rep)])
+                if req["cmd"] == "job":
+                    return
+        finally:
+            router.close(0)
+
+    thread = threading.Thread(target=scripted_master, daemon=True)
+    thread.start()
+    client = Client(wf, endpoint=endpoint, slave_id="efsm")
+    done = client.run(recv_timeout=0.5, max_reconnects=5,
+                      backoff_base=0.05, backoff_cap=0.2)
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    assert done == 0
+    assert client.reconnects == 1        # one fresh-socket retry
+    # the retry re-registered before asking for work again
+    assert seen == ["register", "job", "register", "job"]
+
+
+def test_client_gives_up_cleanly_when_master_gone(tmp_path):
+    """A registered slave whose master vanishes for good exits cleanly
+    after max_reconnects consecutive failures (no exception, no hang)."""
+    import zmq
+
+    from znicz_tpu.client import Client
+
+    endpoint = "tcp://127.0.0.1:17585"
+    wf = _make_workflow(tmp_path / "s")
+
+    def register_then_die():
+        ctx = zmq.Context.instance()
+        router = ctx.socket(zmq.ROUTER)
+        router.setsockopt(zmq.RCVTIMEO, 20_000)
+        router.setsockopt(zmq.LINGER, 0)
+        router.bind(endpoint)
+        try:
+            frames = router.recv_multipart()
+            req = pickle.loads(frames[-1])
+            rep = {"ok": True, "version": req["version"],
+                   "class_lengths": [0, 60, 300]}
+            router.send_multipart(frames[:-1] + [pickle.dumps(rep)])
+        finally:
+            router.close(0)              # master gone for good
+
+    thread = threading.Thread(target=register_then_die, daemon=True)
+    thread.start()
+    client = Client(wf, endpoint=endpoint, slave_id="orphan")
+    done = client.run(recv_timeout=0.3, max_reconnects=2,
+                      backoff_base=0.02, backoff_cap=0.05)
+    thread.join(timeout=10)
+    assert done == 0
+    assert client.reconnects == 2        # spent the whole budget
+
+
+# -- membership hygiene --------------------------------------------------------
+
+
+def test_dead_slave_evicted_and_web_status_counters(tmp_path):
+    """A silent slave is evicted past slave_ttl (its job history kept for
+    the report), must re-register to work again, and the dashboard
+    exposes live/dead membership plus the robustness counters."""
+    import json
+    import urllib.request
+
+    from znicz_tpu.server import Server
+    from znicz_tpu.web_status import WebStatus
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, slave_ttl=0.1)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    jid = server._handle({"cmd": "job", "id": "s1"})["job_id"]
+    server._handle({"cmd": "update", "id": "s1", "job_id": jid,
+                    "deltas": None, "metrics": {"loss": 1.0, "n_err": 0}})
+    time.sleep(0.15)
+    server._evict_dead_slaves()
+    assert "s1" not in server.slaves and "s1" not in server.registered
+    assert "s1" in server.dead_slaves
+    assert server.jobs_by_slave["s1"] == 1       # history survives
+    # an evicted slave gets refused until it re-registers
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    assert rep["ok"] is False and rep.get("unregistered")
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    assert server.reregistrations == 1
+    assert "s1" not in server.dead_slaves        # back from the dead
+
+    server.bad_frames = 3                        # visible on the board
+    status = WebStatus(port=0).start()
+    try:
+        status.register(master_wf)
+        status.register_server(server)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            master = json.load(r)["master"]
+        assert master["bad_frames"] == 3
+        for key in ("quarantined_updates", "reregistrations", "resumed",
+                    "job_timeout_s", "dead_slaves", "bad_updates",
+                    "resume_saves"):
+            assert key in master, key
+        assert master["reregistrations"] == 1
+        assert [s["id"] for s in master["slaves"]] == ["s1"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/") as r:
+            page = r.read().decode()
+        assert "bad frames" in page and "quarantined" in page
+    finally:
+        status.stop()
+
+
+def test_adaptive_job_timeout(tmp_path):
+    """The reap timeout tightens from observed durations (straggler
+    re-dispatch) but never exceeds the configured ceiling and never
+    collapses below the floor."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, job_timeout=30.0)
+    assert server.effective_job_timeout() == 30.0    # <5 samples: as-is
+    server._durations.extend([0.1] * 8)
+    # 8 x 0.1 median + 1s slack = 1.8s — stragglers reaped in seconds
+    assert abs(server.effective_job_timeout() - 1.8) < 1e-9
+    server._durations.extend([10.0] * 24)            # slow-but-alive fleet
+    assert server.effective_job_timeout() == 30.0    # ceiling holds
+    fast = Server(master_wf, job_timeout=0.0)        # tests reap instantly
+    fast._durations.extend([0.01] * 8)
+    assert fast.effective_job_timeout() == 0.0
+
+
+# -- launcher / CLI ------------------------------------------------------------
+
+
+def test_master_resume_cli_flag():
+    from znicz_tpu import launcher
+
+    args = launcher.Launcher(["mnist", "--master-resume", "f.pkl"]).args
+    assert args.master_resume == "f.pkl"
+    # resume is a master-role flag
+    assert launcher.main(["mnist", "--master-resume", "f.pkl",
+                          "--slave", "tcp://127.0.0.1:1"]) == 2
+
+
+# -- the long soak -------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_matches_fault_free(tmp_path):
+    """Everything at once, against a fault-free reference run: frame
+    chaos + mid-job slave death + master kill/resume, and the final
+    validation error must land within tolerance of the undisturbed run
+    (the faults cost work, not correctness)."""
+    from znicz_tpu.client import Client
+    from znicz_tpu.parallel.chaos import (ChaosProxy, FaultSchedule,
+                                          MasterHarness, take_job_and_die)
+    from znicz_tpu.server import Server
+
+    # -- reference: no faults ------------------------------------------
+    ref_wf = _make_workflow(tmp_path / "ref_m", max_epochs=4)
+    ref_server = Server(ref_wf, endpoint="tcp://127.0.0.1:17590",
+                        job_timeout=60.0)
+    ref_slaves = [Client(_make_workflow(tmp_path / f"ref_s{i}",
+                                        max_epochs=4),
+                         endpoint="tcp://127.0.0.1:17590",
+                         slave_id=f"ref{i}") for i in range(2)]
+    threads = [threading.Thread(target=s.run, daemon=True)
+               for s in ref_slaves]
+    for t in threads:
+        t.start()
+    ref_server.serve()
+    for t in threads:
+        t.join(timeout=120)
+    ref_err = ref_wf.decision.epoch_metrics[1]["err_pct"]
+
+    # -- chaos run ------------------------------------------------------
+    front, back = "tcp://127.0.0.1:17591", "tcp://127.0.0.1:17592"
+    proxy = ChaosProxy(front, back, FaultSchedule(SEED, **CHAOS)).start()
+    resume = str(tmp_path / "soak_resume.pickle.gz")
+    harness = MasterHarness(
+        lambda: _make_workflow(tmp_path / "m", max_epochs=4), back, resume,
+        snapshot_every_s=0.25, linger=8.0, job_timeout=6.0)
+    server1 = harness.start()
+    slaves = [Client(_make_workflow(tmp_path / f"s{i}", max_epochs=4),
+                     endpoint=front, slave_id=f"soak{i}")
+              for i in range(2)]
+    errors = []
+
+    def worker(s):
+        try:
+            s.run(recv_timeout=1.0, max_reconnects=80, backoff_base=0.05,
+                  backoff_cap=0.4, connect_retries=80)
+        except BaseException as e:
+            errors.append((s.slave_id, repr(e)))
+            raise
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in slaves]
+    try:
+        for t in threads:
+            t.start()
+        take_job_and_die(front, harness.workflow, "doomed")
+        deadline = time.time() + 90
+        while server1.jobs_done < 4 and time.time() < deadline:
+            time.sleep(0.05)
+        assert server1.jobs_done >= 4
+        saves = server1.resume_saves
+        while server1.resume_saves <= saves and time.time() < deadline:
+            time.sleep(0.05)
+        harness.kill()                   # mid-epoch crash
+        server2 = harness.start()
+        assert server2.resumed
+        assert harness.wait(timeout=300)
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        proxy.stop()
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+
+    dec = harness.workflow.decision
+    assert bool(dec.complete)
+    chaos_err = dec.epoch_metrics[1]["err_pct"]
+    # fault-free convergence tolerance (async replicas differ anyway;
+    # both runs must land in the same converged band)
+    assert abs(chaos_err - ref_err) < 25.0, (chaos_err, ref_err)
+    # accounting still balances under the full fault load
+    assert server2.bad_frames + server1.bad_frames >= 1 or \
+        proxy.counters["req"]["corrupt"] == 0
+    assert server2.jobs_done == sum(server2.jobs_by_slave.values())
+    assert sum(s.reconnects for s in slaves) >= 1

@@ -412,6 +412,88 @@ def test_slave_clean_error_when_no_master(tmp_path):
         client.run(recv_timeout=0.5)
 
 
+def test_segment_max_bad_replies_drops_after_requeues(tmp_path):
+    """PR-1 hardening, now under test: a malformed segment reply (metrics
+    length mismatch) is refused and the job re-queued — but only
+    MAX_BAD_REPLIES times, after which the non-tail segment is DROPPED so
+    a deterministically-broken slave cannot livelock the run."""
+    import numpy as np_
+
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    server = Server(master_wf, segment_steps=3)
+    assert server._handle({"cmd": "register", "id": "s1",
+                           **_handshake_fields(master_wf)})["ok"]
+    # walk the epoch to the first SEGMENT job (eval singletons come first)
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    while "minibatches" not in rep["job"]:
+        server._handle({"cmd": "update", "id": "s1",
+                        "job_id": rep["job_id"], "deltas": None,
+                        "metrics": {"loss": 1.0, "n_err": 0}})
+        rep = server._handle({"cmd": "job", "id": "s1"})
+    seg_idx = np_.array(rep["job"]["minibatches"][0]["indices"])
+    for attempt in range(server.MAX_BAD_REPLIES):
+        bad = server._handle({"cmd": "update", "id": "s1",
+                              "job_id": rep["job_id"], "deltas": None,
+                              "metrics": [{"loss": 1.0}]})   # wrong length
+        assert bad["ok"] is False and "metrics length" in bad["error"]
+        if attempt < server.MAX_BAD_REPLIES - 1:
+            assert server._pending           # refused -> re-queued
+            rep = server._handle({"cmd": "job", "id": "s1"})
+            np_.testing.assert_array_equal(
+                np_.array(rep["job"]["minibatches"][0]["indices"]),
+                seg_idx)                     # the SAME segment came back
+        else:
+            assert not server._pending       # bounded: dropped for good
+    assert server.bad_updates == server.MAX_BAD_REPLIES
+    # the stream moved on: the next job is not that segment again
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    job = rep.get("job")
+    assert job is not None
+    nxt = (job["minibatches"][0]["indices"] if "minibatches" in job
+           else job["indices"])
+    assert not np_.array_equal(np_.array(nxt), seg_idx)
+
+
+def test_tail_reissued_when_tail_slave_dies(tmp_path):
+    """PR-1 epoch-tail ordering under slave death: while the tail is in
+    flight other slaves get _WAIT; when the tail's slave dies the job is
+    reaped and the tail RE-ISSUED — the epoch closes instead of hanging."""
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m")
+    master_wf.decision.max_epochs = 1        # one epoch: tail ends the run
+    server = Server(master_wf, job_timeout=0.2)
+    for sid in ("s1", "s2"):
+        assert server._handle({"cmd": "register", "id": sid,
+                               **_handshake_fields(master_wf)})["ok"]
+    # s1 works the epoch until it holds the TAIL job
+    rep = server._handle({"cmd": "job", "id": "s1"})
+    while not rep["job"].get("last_minibatch"):
+        server._handle({"cmd": "update", "id": "s1",
+                        "job_id": rep["job_id"], "deltas": None,
+                        "metrics": {"loss": 1.0, "n_err": 0}})
+        rep = server._handle({"cmd": "job", "id": "s1"})
+    tail_jid = rep["job_id"]
+    # the tail is outstanding: everyone else must wait, not overrun the
+    # epoch boundary
+    assert server._handle({"cmd": "job", "id": "s2"}) == {"wait": True}
+    # s1 dies without replying; past job_timeout the tail is reaped and
+    # re-issued to s2
+    time.sleep(0.3)
+    rep = server._handle({"cmd": "job", "id": "s2"})
+    assert rep["job"].get("last_minibatch"), rep
+    assert rep["job_id"] != tail_jid
+    assert server.jobs_requeued >= 1
+    up = server._handle({"cmd": "update", "id": "s2",
+                         "job_id": rep["job_id"], "deltas": None,
+                         "metrics": {"loss": 1.0, "n_err": 0}})
+    assert up["ok"] is True
+    assert bool(master_wf.decision.complete)     # epoch closed, no hang
+    assert server._handle({"cmd": "job", "id": "s2"}) == {"done": True}
+
+
 def test_fused_slaves_train_to_quality_band(tmp_path):
     """VERDICT r4 item 5: two FUSED slaves (each job = a FusedTrainer
     scan dispatch over a k-minibatch segment) train MNIST through the

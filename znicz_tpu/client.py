@@ -2,7 +2,15 @@
 computes one minibatch on the LOCAL workflow replica (the slave owns its
 dataset copy like the reference's slaves did — the master only ships
 minibatch indices + params), and pushes back weight deltas + metrics.
-See server.py for the protocol; uses the Distributable payloads."""
+See server.py for the protocol; uses the Distributable payloads.
+
+Fault tolerance (README "Fault tolerance"): a transport fault no longer
+kills the slave.  ``run()`` is a reconnect state machine — a timed-out
+REQ socket is stuck in a broken EFSM state and can NEVER be reused, so
+every retry closes it and connects a FRESH one, waits a capped
+exponential backoff with deterministic per-slave jitter, and re-registers
+before any further job traffic.  That lets a slave ride out frame loss,
+garbage replies, AND a full master restart (``--master-resume``)."""
 
 from __future__ import annotations
 
@@ -16,6 +24,11 @@ import numpy as np
 from znicz_tpu.loader.base import TRAIN
 
 
+class _BadReply(Exception):
+    """A reply frame that did not decode to a dict (truncated/corrupt) —
+    handled exactly like a timeout: fresh socket, backoff, re-register."""
+
+
 class Client:
     def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
                  slave_id: Optional[str] = None):
@@ -23,11 +36,20 @@ class Client:
         self.endpoint = endpoint
         self.slave_id = slave_id or uuid.uuid4().hex[:8]
         self.jobs_done = 0
+        self.reconnects = 0             # fresh-socket retries taken
+        self.bad_replies = 0            # undecodable reply frames seen
 
     def _rpc(self, sock, msg: dict) -> dict:
         msg["id"] = self.slave_id
         sock.send(pickle.dumps(msg))
-        return pickle.loads(sock.recv())
+        raw = sock.recv()               # zmq.Again propagates
+        try:
+            rep = pickle.loads(raw)
+            if not isinstance(rep, dict):
+                raise TypeError(f"reply decodes to {type(rep).__name__}")
+        except Exception as exc:
+            raise _BadReply(str(exc)) from None
+        return rep
 
     def _apply_params(self, params: Dict) -> None:
         for f in self.workflow.forwards:
@@ -81,6 +103,12 @@ class Client:
         import zmq
 
         sock = ctx.socket(zmq.REQ)
+        # duplicate tolerance: RELAXED lets a fresh request follow a
+        # failed cycle; CORRELATE stamps request ids so a duplicated or
+        # stale reply (chaos proxy, restarted master) is DISCARDED
+        # instead of being returned for the NEXT request
+        sock.setsockopt(zmq.REQ_RELAXED, 1)
+        sock.setsockopt(zmq.REQ_CORRELATE, 1)
         sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
         sock.setsockopt(zmq.LINGER, 0)
         sock.connect(self.endpoint)
@@ -89,15 +117,44 @@ class Client:
     def engine_name(self) -> str:
         return "unit"
 
-    def run(self, poll_sleep: float = 0.05,
-            recv_timeout: float = 15.0) -> int:
-        """Work until the master reports done (or goes silent past
-        ``recv_timeout`` — master-death tolerance); returns jobs done."""
+    def run(self, poll_sleep: float = 0.05, recv_timeout: float = 15.0,
+            max_reconnects: Optional[int] = None,
+            backoff_base: Optional[float] = None,
+            backoff_cap: Optional[float] = None,
+            connect_retries: int = 1) -> int:
+        """Work until the master reports done; returns jobs done.
+
+        Reconnect state machine: a timeout or an undecodable reply
+        closes the REQ socket (broken EFSM state — a retry on the same
+        socket would raise ZMQError(EFSM)), backs off exponentially
+        (``backoff_base`` doubling up to ``backoff_cap``, jittered
+        deterministically per slave) and reconnects fresh, re-registering
+        before any job traffic — so a master restart just looks like a
+        long retry.  A pending update survives the reconnect and is
+        re-sent (the master drops it as stale if the job was re-queued:
+        one job, one accepted update).  Gives up cleanly after
+        ``max_reconnects`` CONSECUTIVE failures (master gone for good).
+        ``connect_retries`` bounds only the FIRST contact, so a slave
+        pointed at a dead endpoint still fails fast with ConnectionError.
+        Defaults come from root.common.engine.slave_reconnects /
+        slave_backoff_base / slave_backoff_cap."""
+        import logging
+        import random
+
         import zmq
 
+        from znicz_tpu.core.config import root
+        from znicz_tpu.lr_adjust import LearningRateAdjust
         from znicz_tpu.network_common import handshake_request
 
-        from znicz_tpu.lr_adjust import LearningRateAdjust
+        eng = root.common.engine
+        if max_reconnects is None:
+            max_reconnects = int(eng.get("slave_reconnects", 8))
+        if backoff_base is None:
+            backoff_base = float(eng.get("slave_backoff_base", 0.25))
+        if backoff_cap is None:
+            backoff_cap = float(eng.get("slave_backoff_cap", 5.0))
+        log = logging.getLogger("znicz")
 
         if any(isinstance(u, LearningRateAdjust)
                for u in self.workflow.units):
@@ -106,35 +163,129 @@ class Client:
             # constant tiled_hypers match the unit slave exactly), so an
             # LR schedule silently freezes at its initial value in the
             # async master/slave mode.  Say so instead of being subtle.
-            import logging
-
-            logging.getLogger("znicz").warning(
+            log.warning(
                 "%s: LR schedules do not advance in master/slave mode "
                 "(slaves run gds only); training proceeds at the "
                 "current learning rate", self.slave_id)
 
+        rng = random.Random(f"{self.slave_id}/backoff")
         ctx = zmq.Context.instance()
-        sock = self._connect(ctx, int(recv_timeout * 1000))
-        try:
-            try:
-                rep = self._rpc(sock, handshake_request(self.workflow))
-            except zmq.Again:
-                raise ConnectionError(
-                    f"no master answered at {self.endpoint} within "
-                    f"{recv_timeout:g}s — is the master running "
-                    f"(launcher --master)?") from None
-            if not rep.get("ok"):
+        timeout_ms = int(recv_timeout * 1000)
+        sock = self._connect(ctx, timeout_ms)
+        registered = False
+        ever_registered = False
+        failures = 0                    # CONSECUTIVE transport failures
+        refusals = 0                    # CONSECUTIVE bad_frame replies
+        refusal_cap = max(3, max_reconnects)
+        update_msg: Optional[dict] = None
+
+        def refused() -> bool:
+            """A bad_frame reply means the master is alive but never
+            decoded our frame — retry, BOUNDED: a master that refuses
+            every frame we send (deterministic corruption, version skew)
+            must not spin us forever.  True when the cap is spent."""
+            nonlocal refusals
+            refusals += 1
+            if refusals <= refusal_cap:
+                time.sleep(poll_sleep)
+                return False
+            if not ever_registered:
                 raise RuntimeError(
-                    f"master refused registration: {rep.get('error')}")
+                    f"master at {self.endpoint} refused {refusals} "
+                    "consecutive frames (bad_frame) — giving up")
+            log.warning("%s: master refused %d consecutive frames — "
+                        "giving up", self.slave_id, refusals)
+            return True
+
+        def reconnect(exc) -> bool:
+            """Fresh socket + backoff; False when the budget is spent."""
+            nonlocal sock, registered, failures
+            if isinstance(exc, _BadReply):
+                self.bad_replies += 1
+            failures += 1
+            if not ever_registered:
+                if failures >= connect_retries:
+                    raise ConnectionError(
+                        f"no master answered at {self.endpoint} within "
+                        f"{recv_timeout:g}s — is the master running "
+                        f"(launcher --master)?") from None
+            elif failures > max_reconnects:
+                log.warning(
+                    "%s: giving up after %d consecutive reconnects "
+                    "(master gone for good?)", self.slave_id, failures - 1)
+                return False
+            sock.close(0)               # EFSM: unusable after a timeout
+            self.reconnects += 1
+            registered = False
+            delay = min(backoff_cap,
+                        backoff_base * (2 ** min(failures - 1, 16)))
+            time.sleep(delay * (0.5 + rng.random()))
+            sock = self._connect(ctx, timeout_ms)
+            return True
+
+        try:
             while True:
+                if not registered:
+                    try:
+                        rep = self._rpc(sock,
+                                        handshake_request(self.workflow))
+                    except (zmq.Again, _BadReply) as exc:
+                        if not reconnect(exc):
+                            break
+                        continue
+                    failures = 0        # any reply: the master is alive
+                    if rep.get("bad_frame"):
+                        if refused():
+                            break
+                        continue
+                    refusals = 0
+                    if not rep.get("ok"):
+                        raise RuntimeError(
+                            f"master refused registration: "
+                            f"{rep.get('error')}")
+                    registered = ever_registered = True
+                    continue
+                if update_msg is not None:
+                    try:
+                        rep = self._rpc(sock, update_msg)
+                    except (zmq.Again, _BadReply) as exc:
+                        if not reconnect(exc):
+                            break
+                        continue        # re-register, then RE-SEND it
+                    failures = 0
+                    if rep.get("bad_frame"):
+                        if refused():
+                            break       # master re-queues it by timeout
+                        continue        # master never decoded it: resend
+                    refusals = 0
+                    if rep.get("unregistered"):
+                        registered = False      # master restarted
+                        continue
+                    if rep.get("quarantined"):
+                        log.warning("%s: master quarantined our delta: %s",
+                                    self.slave_id, rep.get("error"))
+                    update_msg = None
+                    self.jobs_done += 1
+                    continue
                 try:
                     rep = self._rpc(sock, {"cmd": "job"})
-                except zmq.Again:
-                    return self.jobs_done       # master gone -> stop clean
+                except (zmq.Again, _BadReply) as exc:
+                    if not reconnect(exc):
+                        break
+                    continue
+                failures = 0
+                if rep.get("bad_frame"):
+                    if refused():
+                        break
+                    continue
+                refusals = 0
                 if rep.get("done"):
-                    return self.jobs_done
+                    break
+                if rep.get("unregistered"):
+                    registered = False
+                    continue
                 if "job" not in rep:
-                    time.sleep(poll_sleep)
+                    time.sleep(poll_sleep)     # wait: master re-asks soon
                     continue
                 job, params = rep["job"], rep["params"]
                 self._apply_params(params)
@@ -143,15 +294,11 @@ class Client:
                 train = bool(rep.get("train"))
                 metrics = self._run_minibatch(job, train)
                 deltas = self._deltas_since(before) if train else None
-                try:
-                    self._rpc(sock, {"cmd": "update",
-                                     "job_id": rep["job_id"],
-                                     "deltas": deltas, "metrics": metrics})
-                except zmq.Again:
-                    return self.jobs_done       # master gone mid-update
-                self.jobs_done += 1
+                update_msg = {"cmd": "update", "job_id": rep["job_id"],
+                              "deltas": deltas, "metrics": metrics}
         finally:
             sock.close(0)
+        return self.jobs_done
 
 
 class FusedClient(Client):

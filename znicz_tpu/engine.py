@@ -47,9 +47,13 @@ def train(workflow) -> None:
         from znicz_tpu.server import Server
 
         _check_distributable(workflow, mode)
+        # --master-resume: restore mid-training state when the file
+        # exists and keep it updated while serving (crash-resume)
         Server(workflow,
                endpoint=root.common.engine.get("master_bind",
-                                               "tcp://*:5570")).serve()
+                                               "tcp://*:5570"),
+               resume_path=root.common.engine.get("master_resume",
+                                                  "")).serve()
         return
     if mode == "slave":
         from znicz_tpu.client import Client, FusedClient

@@ -82,6 +82,11 @@ class Launcher:
         parser.add_argument("--slave", default=None, metavar="ENDPOINT",
                             help="work for the master at ENDPOINT "
                                  "(e.g. tcp://host:5570)")
+        parser.add_argument("--master-resume", default="", metavar="FILE",
+                            help="master crash-resume file: restore "
+                                 "training state from FILE when it "
+                                 "exists and keep it updated while "
+                                 "serving (implies --master)")
         parser.add_argument("--fitness", action="store_true",
                             help="print a final JSON line with the run's "
                                  "fitness (genetics subprocess evaluation)")
@@ -118,6 +123,14 @@ class Launcher:
             print("error: --master and --slave are mutually exclusive",
                   file=sys.stderr)
             return 2
+        if args.master_resume:
+            if args.slave is not None:
+                print("error: --master-resume applies to the master role",
+                      file=sys.stderr)
+                return 2
+            root.common.engine.master_resume = args.master_resume
+            if args.master is None:
+                args.master = "tcp://*:5570"      # implies --master
         if args.master is not None:
             root.common.engine.mode = "master"
             root.common.engine.master_bind = args.master

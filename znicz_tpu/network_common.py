@@ -17,8 +17,15 @@ import hashlib
 import json
 from typing import Optional
 
-#: bump on any incompatible change to the job/update message schema
-PROTOCOL_VERSION = 1
+#: bump on any incompatible change to the job/update message schema.
+#: v2 (fault-tolerance rev): an unregistered peer's job/update gets
+#: ``{"unregistered": True}`` instead of ``{"done": True}`` (a slave must
+#: re-register after a master restart, not exit); refused frames reply
+#: ``{"bad_frame": True}``; quarantined deltas reply
+#: ``{"quarantined": True}``; the register reply carries ``resumed`` and
+#: ``epoch`` so a reconnecting slave can tell a crash-resumed master from
+#: a fresh one.
+PROTOCOL_VERSION = 2
 
 
 #: structural attributes that define a unit's computation (beyond its

@@ -1,0 +1,297 @@
+"""Seeded chaos injection for the async master/slave stack (server.py /
+client.py) — the fault-tolerance layer's proof harness.
+
+Three pieces:
+
+  - :class:`FaultSchedule`: deterministic per-frame fault decisions — a
+    pure function of ``(seed, frame_index)``, so two runs with the same
+    seed produce IDENTICAL fault schedules (the CI determinism contract);
+  - :class:`ChaosProxy`: a ZeroMQ ROUTER<->DEALER proxy between REQ
+    slaves and the REP master that drops, delays, duplicates, and
+    corrupts frames per the schedule.  Only the LAST frame (the pickle
+    payload) is ever corrupted — the routing envelope stays intact, so a
+    refusal reply still finds its way back to the broken peer.  Every
+    decision is counted per direction (``req`` = slave->master, ``rep`` =
+    master->slave) and logged, so a test can hold the master's/slaves'
+    robustness counters to account for every injected fault;
+  - process-level kill harnesses: :func:`take_job_and_die` (a slave that
+    takes a job and vanishes mid-job) and :class:`MasterHarness`
+    (kill/restart a Server mid-epoch, restoring from its crash-resume
+    snapshot — the ``--master-resume`` path).
+
+Everything is CPU-only, in-process, and seeded: the chaos suite runs
+deterministically in CI forever after (ISSUE 2).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: schedule actions, in cumulative-probability order
+ACTIONS = ("drop", "corrupt", "dup", "delay", "forward")
+
+
+class FaultSchedule:
+    """Deterministic fault decisions: ``decide(i)`` derives a fresh RNG
+    from ``(seed, i)``, so the decision for frame *i* depends on nothing
+    but the seed — not on thread timing, not on how many frames came
+    before.  Two schedules with the same seed are identical everywhere.
+
+    Probabilities are per frame and must sum to < 1; the remainder is
+    forwarded untouched.  ``delay_s`` bounds the injected latency — keep
+    its upper bound below the slaves' ``recv_timeout`` or every delay
+    also becomes a (counted) client reconnect.
+    """
+
+    def __init__(self, seed: int, drop: float = 0.0, corrupt: float = 0.0,
+                 duplicate: float = 0.0, delay: float = 0.0,
+                 delay_s: Tuple[float, float] = (0.05, 0.2)):
+        total = drop + corrupt + duplicate + delay
+        if not 0.0 <= total < 1.0:
+            raise ValueError(f"fault probabilities sum to {total}; "
+                             "must be in [0, 1)")
+        self.seed = int(seed)
+        self.drop = float(drop)
+        self.corrupt = float(corrupt)
+        self.duplicate = float(duplicate)
+        self.delay = float(delay)
+        self.delay_s = (float(delay_s[0]), float(delay_s[1]))
+
+    def decide(self, frame_no: int) -> Tuple[str, float]:
+        """(action, delay_seconds) for the frame_no-th frame."""
+        rng = np.random.default_rng((self.seed, int(frame_no)))
+        u = float(rng.random())
+        edge = self.drop
+        if u < edge:
+            return "drop", 0.0
+        edge += self.corrupt
+        if u < edge:
+            return "corrupt", 0.0
+        edge += self.duplicate
+        if u < edge:
+            return "dup", 0.0
+        edge += self.delay
+        if u < edge:
+            lo, hi = self.delay_s
+            return "delay", lo + float(rng.random()) * (hi - lo)
+        return "forward", 0.0
+
+    def decisions(self, n: int) -> List[Tuple[str, float]]:
+        """The first ``n`` decisions — the full fault schedule a run of
+        ``n`` frames would see (the determinism-test surface)."""
+        return [self.decide(i) for i in range(n)]
+
+
+def corrupt_payload(payload: bytes) -> bytes:
+    """Deterministic frame corruption: truncate to a third and flip the
+    first byte — reliably undecodable by pickle, like a torn write."""
+    cut = max(1, len(payload) // 3)
+    head = bytearray(payload[:cut])
+    head[0] ^= 0xFF
+    return bytes(head)
+
+
+class ChaosProxy:
+    """Seeded fault-injecting ROUTER<->DEALER proxy.
+
+    Slaves connect their REQ sockets to ``front_endpoint``; the proxy
+    relays to the master's REP socket at ``back_endpoint``.  Frames are
+    numbered in arrival order across both directions and each gets one
+    :class:`FaultSchedule` decision.  ``counters[direction][action]``
+    and ``log`` (``(frame_no, direction, action)``) record everything
+    injected, so nothing is lost silently even by the chaos itself.
+    """
+
+    def __init__(self, front_endpoint: str, back_endpoint: str,
+                 schedule: FaultSchedule):
+        self.front_endpoint = front_endpoint
+        self.back_endpoint = back_endpoint
+        self.schedule = schedule
+        self.counters: Dict[str, Dict[str, int]] = {
+            d: {a: 0 for a in ACTIONS} for d in ("req", "rep")}
+        self.log: List[Tuple[int, str, str]] = []
+        self._frame_no = 0
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def faults_toward(self, direction: str) -> int:
+        """Injected faults a peer in ``direction``'s receive path can
+        observe as a timeout or bad reply: drops (either way starve the
+        requester) plus corruptions of that direction's frames."""
+        c = self.counters
+        return (c["req"]["drop"] + c["rep"]["drop"]
+                + c[direction]["corrupt"])
+
+    def total_faults(self) -> int:
+        return sum(n for d in self.counters.values()
+                   for a, n in d.items() if a != "forward")
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "ChaosProxy":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="chaos-proxy")
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError("chaos proxy failed to bind "
+                               f"{self.front_endpoint}")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- the relay loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        front = ctx.socket(zmq.ROUTER)  # slaves' REQ sockets connect here
+        back = ctx.socket(zmq.DEALER)   # relays to the master's REP
+        front.setsockopt(zmq.LINGER, 0)
+        back.setsockopt(zmq.LINGER, 0)
+        front.bind(self.front_endpoint)
+        back.connect(self.back_endpoint)
+        self._ready.set()
+        poller = zmq.Poller()
+        poller.register(front, zmq.POLLIN)
+        poller.register(back, zmq.POLLIN)
+        held: list = []                 # (release_t, seq, out_sock, frames)
+        seq = 0
+        try:
+            while not self._stop.is_set():
+                now = time.time()
+                while held and held[0][0] <= now:
+                    _, _, out, frames = heapq.heappop(held)
+                    out.send_multipart(frames)
+                timeout_ms = 20
+                if held:
+                    timeout_ms = max(1, min(
+                        timeout_ms, int((held[0][0] - now) * 1000)))
+                for sock, _ in poller.poll(timeout_ms):
+                    frames = sock.recv_multipart()
+                    direction = "req" if sock is front else "rep"
+                    out = back if sock is front else front
+                    action, delay = self.schedule.decide(self._frame_no)
+                    self.counters[direction][action] += 1
+                    self.log.append((self._frame_no, direction, action))
+                    self._frame_no += 1
+                    if action == "drop":
+                        continue
+                    if action == "corrupt":
+                        frames = frames[:-1] + [corrupt_payload(frames[-1])]
+                        out.send_multipart(frames)
+                    elif action == "dup":
+                        out.send_multipart(frames)
+                        out.send_multipart(frames)
+                    elif action == "delay":
+                        seq += 1
+                        heapq.heappush(
+                            held, (time.time() + delay, seq, out, frames))
+                    else:
+                        out.send_multipart(frames)
+        finally:
+            front.close(0)
+            back.close(0)
+
+
+# -- process-level kill harness ------------------------------------------------
+
+
+def take_job_and_die(endpoint: str, workflow, slave_id: str = "doomed",
+                     timeout_ms: int = 10_000) -> Optional[int]:
+    """The canonical mid-job slave death: register, take ONE job, vanish
+    without replying.  Returns the job_id the master now holds in flight
+    — it must come back via the reaper (``jobs_requeued``) for the
+    no-silent-loss property to hold — or None if training already ended.
+    """
+    import pickle
+
+    import zmq
+
+    from znicz_tpu.network_common import handshake_request
+
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.REQ)
+    sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.connect(endpoint)
+    try:
+        msg = handshake_request(workflow)
+        msg["id"] = slave_id
+        sock.send(pickle.dumps(msg))
+        rep = pickle.loads(sock.recv())
+        if not rep.get("ok"):
+            raise RuntimeError(f"registration refused: {rep.get('error')}")
+        while True:
+            sock.send(pickle.dumps({"cmd": "job", "id": slave_id}))
+            rep = pickle.loads(sock.recv())
+            if "job" in rep:
+                return rep["job_id"]
+            if rep.get("done"):
+                return None
+            time.sleep(0.05)
+    finally:
+        sock.close(0)                   # died mid-job, update never sent
+
+
+class MasterHarness:
+    """Kill/restart driver for the master half of the chaos harness.
+
+    ``start()`` builds a fresh workflow + Server (restoring from
+    ``resume_path`` when the file exists — exactly what a restarted
+    ``--master-resume`` process does) and serves it on a daemon thread;
+    ``kill()`` is a simulated crash: serving stops at the next poll tick
+    with NO final snapshot, so only the periodic resume snapshot
+    survives.  ``wait()`` joins the serving thread.
+    """
+
+    def __init__(self, make_workflow, endpoint: str, resume_path: str,
+                 snapshot_every_s: float = 0.3, linger: float = 3.0,
+                 **server_kwargs):
+        self.make_workflow = make_workflow
+        self.endpoint = endpoint
+        self.resume_path = resume_path
+        self.snapshot_every_s = snapshot_every_s
+        self.linger = linger
+        self.server_kwargs = server_kwargs
+        self.server = None
+        self.workflow = None
+        self.kills = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        from znicz_tpu.server import Server
+
+        self.workflow = self.make_workflow()
+        self.server = Server(self.workflow, endpoint=self.endpoint,
+                             resume_path=self.resume_path,
+                             snapshot_every_s=self.snapshot_every_s,
+                             **self.server_kwargs)
+        self._thread = threading.Thread(
+            target=self.server.serve, kwargs={"linger": self.linger},
+            daemon=True, name="chaos-master")
+        self._thread.start()
+        return self.server
+
+    def kill(self, timeout: float = 30.0) -> None:
+        """Simulated master crash mid-epoch (no final snapshot)."""
+        self.server.stop()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise RuntimeError("master thread did not stop")
+        self.kills += 1
+
+    def wait(self, timeout: float = 120.0) -> bool:
+        """Join the serving thread; True when it exited (run complete)."""
+        self._thread.join(timeout)
+        return not self._thread.is_alive()

@@ -20,9 +20,13 @@ pickle-over-ZMQ (trusted-cluster assumption documented there too).
 
 from __future__ import annotations
 
+import collections
+import os
 import pickle
 import time
 from typing import Dict, List, Optional
+
+import numpy as np
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
@@ -33,10 +37,22 @@ class Server:
 
     workflow requirements: ``loader``, ``forwards``, ``decision`` — the
     graph built by StandardWorkflow or the samples.
+
+    Fault model (see README "Fault tolerance"): undecodable/malformed
+    frames are refused and counted (``bad_frames``), never fatal; deltas
+    with non-finite values or an exploded norm are quarantined (refused +
+    re-queued under the bounded ``MAX_BAD_REPLIES`` policy) so one
+    diverging slave cannot poison the global params; the reap timeout
+    adapts to observed job durations; silent slaves are evicted from the
+    membership table; and with ``resume_path`` set the master
+    periodically snapshots its full training state so a crashed master
+    restarts mid-training (``--master-resume``).
     """
 
     def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
-                 job_timeout: float = 30.0, segment_steps: int = None):
+                 job_timeout: float = 30.0, segment_steps: int = None,
+                 resume_path: str = "", snapshot_every_s: float = None,
+                 slave_ttl: float = None):
         from znicz_tpu.core.config import root
 
         self.workflow = workflow
@@ -57,16 +73,51 @@ class Server:
         self.decision = workflow.decision
         self.slaves: Dict[str, float] = {}          # id -> last seen
         self.registered: set = set()                # handshake-passed ids
+        self.dead_slaves: Dict[str, float] = {}     # evicted id -> last seen
+        self._ever_registered: set = set()
         self.jobs_done = 0
         self.jobs_requeued = 0
         self.stale_updates = 0
         self.bad_updates = 0            # malformed replies refused+requeued
+        self.bad_frames = 0             # undecodable/garbage frames refused
+        self.quarantined_updates = 0    # non-finite / norm-exploded deltas
+        self.reregistrations = 0        # re-registers (slave reconnects)
+        self.resume_saves = 0           # crash-resume snapshots written
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
         self._job_seq = 0
         self._hold = None                           # segment-overshoot mb
         self._socket = None
+        self._stop = False
+        #: silent-slave eviction window, seconds (<= 0 disables); evicted
+        #: ids keep their jobs_by_slave history for the final report
+        self.slave_ttl = float(
+            root.common.engine.get("slave_ttl", 60.0)
+            if slave_ttl is None else slave_ttl)
+        #: observed job round-trip durations; with >= 5 samples the reap
+        #: timeout becomes adaptive (see effective_job_timeout)
+        self._durations: collections.deque = collections.deque(maxlen=64)
+        self.job_timeout_mult = float(
+            root.common.engine.get("job_timeout_mult", 8.0))
+        #: recent accepted-delta L2 norms; a new delta whose norm exceeds
+        #: quarantine_norm_mult x the running median is refused
+        self._delta_norms: collections.deque = collections.deque(maxlen=64)
+        self.quarantine_norm_mult = float(
+            root.common.engine.get("quarantine_norm_mult", 25.0))
+        self._param_shapes = None       # lazy {layer: {param: shape}}
+        #: crash-resume: when set, serve() writes the master's full
+        #: training state here every snapshot_every_s seconds, and a
+        #: Server constructed while the file exists restores from it
+        #: (the launcher's --master-resume)
+        self.resume_path = str(resume_path or "")
+        self.snapshot_every_s = float(
+            root.common.engine.get("master_snapshot_s", 10.0)
+            if snapshot_every_s is None else snapshot_every_s)
+        self._last_resume_save = 0.0
+        self.resumed = False
+        if self.resume_path and os.path.exists(self.resume_path):
+            self.restore_resume(self.resume_path)
 
     # -- params <-> payloads ---------------------------------------------------
 
@@ -89,14 +140,86 @@ class Server:
 
     # -- job management --------------------------------------------------------
 
+    def effective_job_timeout(self) -> float:
+        """The reap timeout, adapted from observed job durations: the
+        configured ``job_timeout`` is the ceiling (dead-slave safety
+        net), but once >= 5 round trips have been observed a straggler is
+        re-dispatched after ``job_timeout_mult`` x the median duration
+        (+1s slack) — fast fleets recover lost jobs in seconds without
+        punishing slow-but-alive (e.g. unit-engine) slaves, whose own
+        durations raise the median."""
+        durations = list(self._durations)   # copy: read from other threads
+        if len(durations) < 5:
+            return self.job_timeout
+        adaptive = self.job_timeout_mult * float(np.median(durations)) + 1.0
+        return min(self.job_timeout, max(adaptive, 0.5))
+
     def _reap_lost_jobs(self) -> None:
         now = time.time()
+        timeout = self.effective_job_timeout()
         lost = [jid for jid, (_, t, _) in self._inflight.items()
-                if now - t > self.job_timeout]
+                if now - t > timeout]
         for jid in lost:
             job, _, sid = self._inflight.pop(jid)
             self._pending.append(job)
             self.jobs_requeued += 1
+
+    def _evict_dead_slaves(self) -> None:
+        """Membership hygiene: a slave silent past ``slave_ttl`` is moved
+        to ``dead_slaves`` (its jobs_by_slave history survives for the
+        report) and must re-register to work again; its in-flight jobs
+        come back via the normal reaper."""
+        if self.slave_ttl <= 0:
+            return
+        now = time.time()
+        for sid in [s for s, seen in self.slaves.items()
+                    if now - seen > self.slave_ttl]:
+            import logging
+
+            self.dead_slaves[sid] = self.slaves.pop(sid)
+            self.registered.discard(sid)
+            logging.getLogger("znicz").info(
+                "slave %s evicted (silent for %.0fs)", sid, self.slave_ttl)
+
+    def _quarantine_reason(self, deltas: Dict) -> Optional[str]:
+        """Refusal reason for a delta payload that must never touch the
+        global params: a leaf whose shape does not match the target param
+        (apply_deltas would raise mid-apply, tearing the update), any
+        non-finite value, or a global L2 norm beyond
+        ``quarantine_norm_mult`` x the running median of accepted-update
+        norms (>= 5 samples).  Accepted norms feed the history;
+        quarantined ones do not (a diverging slave must not drag the
+        median up to its own level).  NEVER raises — a payload too broken
+        to inspect is itself the quarantine reason (by the time this
+        runs the job has left _inflight, so an exception would lose it)."""
+        try:
+            if self._param_shapes is None:   # fixed after initialize()
+                self._param_shapes = {
+                    f.name: {k: tuple(a.shape)
+                             for k, a in f.params().items()}
+                    for f in self._trainables()}
+            shapes = self._param_shapes
+            total = 0.0
+            for name, layer in deltas.items():
+                for k, arr in (layer or {}).items():
+                    a = np.asarray(arr, np.float64)
+                    want = shapes.get(name, {}).get(k)
+                    if want is not None and tuple(a.shape) != want:
+                        return (f"shape {tuple(a.shape)} != {want} "
+                                f"for {name}.{k}")
+                    if not np.all(np.isfinite(a)):
+                        return "non-finite values"
+                    total += float(np.dot(a.ravel(), a.ravel()))
+        except Exception as exc:
+            return f"undecodable delta payload: {exc!r}"
+        norm = float(np.sqrt(total))
+        if len(self._delta_norms) >= 5:
+            med = float(np.median(self._delta_norms))
+            if med > 0.0 and norm > self.quarantine_norm_mult * med:
+                return (f"norm {norm:.3g} > {self.quarantine_norm_mult:g} "
+                        f"x median {med:.3g}")
+        self._delta_norms.append(norm)
+        return None
 
     def _advance_mb(self) -> dict:
         if self._hold is not None:
@@ -173,6 +296,32 @@ class Server:
         return {"kind": "segment", "minibatches": seg,
                 "class": TRAIN, "size": sum(m["size"] for m in seg)}
 
+    def _refuse_update(self, job: dict, sid: str, why: str,
+                       counter: str = "bad_updates",
+                       quarantined: bool = False) -> dict:
+        """The ONE home for the refuse/requeue/drop policy on a bad
+        update (malformed payloads and quarantined deltas alike):
+        counted under ``counter``, logged, and the job (already popped
+        from _inflight) re-queued under the bounded MAX_BAD_REPLIES
+        policy — except a TAIL job, which is always re-queued because
+        the epoch cannot close without its feed."""
+        import logging
+
+        setattr(self, counter, getattr(self, counter) + 1)
+        job["_bad_replies"] = job.get("_bad_replies", 0) + 1
+        requeue = (bool(job.get("last_minibatch"))
+                   or job["_bad_replies"] < self.MAX_BAD_REPLIES)
+        logging.getLogger("znicz").warning(
+            "slave %s: %s — refusing the update and %s", sid, why,
+            "re-queueing the job" if requeue else
+            "DROPPING the job (repeated bad replies)")
+        if requeue:
+            self._pending.append(job)
+        rep = {"ok": False, "error": why}
+        if quarantined:
+            rep["quarantined"] = True
+        return rep
+
     def _feed_decision(self, job: dict, metrics: dict) -> None:
         d = self.decision
         d.minibatch_class = job["class"]
@@ -187,7 +336,118 @@ class Server:
             d.confusion_matrix = metrics.get("confusion")
         d.run()
 
+    # -- crash-resume ----------------------------------------------------------
+
+    def save_resume(self, path: str) -> None:
+        """Write the master's full training state: params/velocities and
+        loader/decision/prng cursors via the snapshotter, plus the
+        server-side extras a restart needs — the loader's intra-epoch
+        position, every outstanding job (in flight + pending: the
+        minibatches a crash would otherwise silently lose), the job-id
+        sequence (so pre-crash updates stay stale instead of colliding),
+        the mid-epoch decision accumulators, and the robustness
+        counters/history."""
+        from znicz_tpu import snapshotter
+
+        snap = snapshotter.collect(self.workflow)
+        d = self.decision
+        acc = {"loss": list(d._acc_loss), "batches": list(d._acc_batches)}
+        if hasattr(d, "_acc_n_err"):
+            acc["n_err"] = list(d._acc_n_err)
+            acc["samples"] = list(d._acc_samples)
+            acc["confusion"] = [None if c is None else np.asarray(c)
+                                for c in d._acc_confusion]
+        snap["master"] = {
+            "loader_pos": int(self.loader._pos),
+            "hold": self._hold,
+            "outstanding": [
+                {k: v for k, v in j.items() if k != "_bad_replies"}
+                for j in self._outstanding()],
+            "job_seq": self._job_seq,
+            "jobs_by_slave": dict(self.jobs_by_slave),
+            "decision_acc": acc,
+            "durations": list(self._durations),
+            "delta_norms": list(self._delta_norms),
+            "counters": {
+                "jobs_done": self.jobs_done,
+                "jobs_requeued": self.jobs_requeued,
+                "stale_updates": self.stale_updates,
+                "bad_updates": self.bad_updates,
+                "bad_frames": self.bad_frames,
+                "quarantined_updates": self.quarantined_updates,
+                "reregistrations": self.reregistrations,
+            },
+        }
+        # compression keyed to the extension: Snapshotter.load picks its
+        # opener by suffix, so a gzipped file under a non-.gz name would
+        # be unreadable at restart — the one moment it must not be
+        snapshotter.write_host_pickle(
+            path, snap, "gz" if path.endswith(".gz") else "none")
+        self.resume_saves += 1
+
+    def restore_resume(self, path: str) -> None:
+        """Restore from a ``save_resume`` file onto the (initialized)
+        workflow: training continues from the snapshot point — jobs that
+        were outstanding at save time are re-queued, updates issued after
+        it are re-done (the stream replays; nothing is silently lost),
+        and slaves simply re-register and keep working."""
+        import logging
+
+        from znicz_tpu import snapshotter
+
+        snap = snapshotter.Snapshotter.load(path)
+        snapshotter.restore(self.workflow, snap)
+        m = snap.get("master", {})
+        self.loader._pos = int(m.get("loader_pos", 0))
+        self._hold = m.get("hold")
+        self._pending = list(m.get("outstanding", []))
+        self._inflight.clear()
+        # jobs issued AFTER the snapshot reused ids the snapshot never
+        # saw — restart far past them so a surviving slave's re-sent
+        # pre-crash update can only ever be stale, never collide with a
+        # freshly-issued id (it would be applied against the wrong job)
+        self._job_seq = int(m.get("job_seq", 0)) + 100_000
+        self.jobs_by_slave = dict(m.get("jobs_by_slave", {}))
+        self._durations = collections.deque(m.get("durations", []),
+                                            maxlen=64)
+        self._delta_norms = collections.deque(m.get("delta_norms", []),
+                                              maxlen=64)
+        for name, value in m.get("counters", {}).items():
+            setattr(self, name, int(value))
+        acc = m.get("decision_acc", {})
+        d = self.decision
+        if "loss" in acc:
+            d._acc_loss = list(acc["loss"])
+            d._acc_batches = list(acc["batches"])
+        if "n_err" in acc and hasattr(d, "_acc_n_err"):
+            d._acc_n_err = list(acc["n_err"])
+            d._acc_samples = list(acc["samples"])
+            d._acc_confusion = list(acc["confusion"])
+        self.resumed = True
+        logging.getLogger("znicz").info(
+            "master resumed from %s: epoch %d, %d jobs done, "
+            "%d outstanding jobs re-queued", path,
+            int(self.loader.epoch_number), self.jobs_done,
+            len(self._pending))
+
+    def _maybe_save_resume(self) -> None:
+        if not self.resume_path or self.snapshot_every_s <= 0:
+            return
+        if bool(self.decision.complete):
+            return
+        now = time.time()
+        if now - self._last_resume_save < self.snapshot_every_s:
+            return
+        self._last_resume_save = now
+        self.save_resume(self.resume_path)
+
     # -- the REP loop ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Ask serve() to exit at its next poll tick WITHOUT the
+        end-of-run drain — the chaos harness's simulated master crash
+        (state survives only in the periodic resume snapshot)."""
+        self._stop = True
 
     def serve(self, linger: float = 3.0) -> None:
         """Blocks until the decision completes, then keeps draining for
@@ -197,13 +457,24 @@ class Server:
         import zmq
 
         ctx = zmq.Context.instance()
+        self._stop = False
         self._socket = ctx.socket(zmq.REP)
-        self._socket.bind(self.endpoint)
+        # a restarted master can race the dying one's port release;
+        # retry ONLY that race — any other bind error (bad host, EACCES)
+        # is permanent and must surface immediately
+        for attempt in range(40):
+            try:
+                self._socket.bind(self.endpoint)
+                break
+            except zmq.error.ZMQError as exc:
+                if exc.errno != zmq.EADDRINUSE or attempt == 39:
+                    raise
+                time.sleep(0.05)
         poller = zmq.Poller()
         poller.register(self._socket, zmq.POLLIN)
         deadline = None
         try:
-            while True:
+            while not self._stop:
                 if bool(self.decision.complete):
                     # jobs still out with crashed slaves will never be
                     # re-served — reap on timeout and drop, else serve()
@@ -216,12 +487,49 @@ class Server:
                     deadline = time.time() + linger
                 if deadline is not None and time.time() > deadline:
                     break
+                self._evict_dead_slaves()
+                self._maybe_save_resume()
                 if poller.poll(100):
-                    req = pickle.loads(self._socket.recv())
-                    self._socket.send(pickle.dumps(self._handle(req)))
+                    rep = self._reply(self._socket.recv())
+                    self._socket.send(pickle.dumps(rep))
         finally:
             self._socket.close(0)
             self._socket = None
+            if (self.resume_path and not self._stop
+                    and bool(self.decision.complete)
+                    and os.path.exists(self.resume_path)):
+                # training finished: the crash-resume file has done its
+                # job — left behind, a RERUN of the same --master-resume
+                # command would silently restore stale mid-training state
+                os.remove(self.resume_path)
+
+    def _reply(self, raw: bytes) -> dict:
+        """Decode + dispatch one frame.  NEVER raises: a truncated or
+        garbage frame from a broken peer — or a request that decodes but
+        trips _handle — is refused with an error reply and counted,
+        instead of raising out of the REP loop and killing the master."""
+        import logging
+
+        try:
+            req = pickle.loads(raw)
+            if not isinstance(req, dict):
+                raise TypeError(
+                    f"decodes to {type(req).__name__}, not a request dict")
+        except Exception as exc:
+            self.bad_frames += 1
+            logging.getLogger("znicz").warning(
+                "refused undecodable frame (%d bytes): %s — bad_frames=%d",
+                len(raw), exc, self.bad_frames)
+            return {"ok": False, "bad_frame": True,
+                    "error": f"bad frame: {exc}"}
+        try:
+            return self._handle(req)
+        except Exception as exc:
+            self.bad_frames += 1
+            logging.getLogger("znicz").exception(
+                "refused malformed request %r", req.get("cmd"))
+            return {"ok": False, "bad_frame": True,
+                    "error": f"malformed request: {exc!r}"}
 
     def _handle(self, req: dict) -> dict:
         cmd = req.get("cmd")
@@ -237,14 +545,25 @@ class Server:
                 self.slaves.pop(sid, None)      # refused != member
                 self.registered.discard(sid)
                 return {"ok": False, "error": refusal}
+            self.dead_slaves.pop(sid, None)     # back from the dead
+            if sid in self._ever_registered or sid in self.jobs_by_slave:
+                # a repeat register = a slave reconnect (backoff retry or
+                # a peer re-joining a crash-resumed master, whose job
+                # history came back with the snapshot)
+                self.reregistrations += 1
+            self._ever_registered.add(sid)
             self.registered.add(sid)
             self.slaves[sid] = time.time()
             return {"ok": True, "version": PROTOCOL_VERSION,
-                    "class_lengths": list(self.loader.class_lengths)}
+                    "class_lengths": list(self.loader.class_lengths),
+                    "resumed": self.resumed,
+                    "epoch": int(self.loader.epoch_number)}
         if cmd in ("job", "update") and sid not in self.registered:
             # the handshake is a gate, not advice: a refused (or never
-            # registered) peer gets no params and applies no deltas
-            return {"ok": False, "done": True,
+            # registered) peer gets no params and applies no deltas.
+            # ``unregistered`` (protocol v2, NOT ``done``) tells a slave
+            # that outlived a master restart to re-register, not exit.
+            return {"ok": False, "unregistered": True,
                     "error": f"slave {sid!r} is not registered"}
         if cmd == "job":
             if bool(self.decision.complete):
@@ -269,7 +588,14 @@ class Server:
                 # bound: one job, one accepted update)
                 self.stale_updates += 1
                 return {"ok": False, "stale": True}
-            job, _, _ = entry
+            job, t_issued, _ = entry
+            # round-trip duration of a slave that DID answer — feeds the
+            # adaptive reap timeout (recorded even for replies refused
+            # below: they still prove the slave's latency)
+            self._durations.append(time.time() - t_issued)
+            # NOTE: from here on the job is out of _inflight — every
+            # refusal path below must either re-queue it or drop it
+            # DELIBERATELY (bounded policy); nothing may raise.
             if "minibatches" in job:
                 # a segment reply must carry one metrics dict PER
                 # minibatch — a short (or long) list means the slave ran
@@ -283,24 +609,29 @@ class Server:
                 # (its metrics are lost like a stale update's; Decision
                 # control flow never depends on non-tail feeds).
                 ms = req.get("metrics") or []
-                if len(ms) != len(job["minibatches"]):
-                    import logging
-
-                    self.bad_updates += 1
-                    job["_bad_replies"] = job.get("_bad_replies", 0) + 1
-                    requeue = job["_bad_replies"] < self.MAX_BAD_REPLIES
-                    logging.getLogger("znicz").warning(
-                        "slave %s: segment update carries %d metrics for "
-                        "%d minibatches — refusing the update and %s",
-                        sid, len(ms), len(job["minibatches"]),
-                        "re-queueing the job" if requeue else
-                        "DROPPING the job (repeated malformed replies)")
-                    if requeue:
-                        self._pending.append(job)
-                    return {"ok": False,
-                            "error": f"segment metrics length {len(ms)} "
-                                     f"!= {len(job['minibatches'])}"}
+                if not isinstance(ms, (list, tuple)) \
+                        or len(ms) != len(job["minibatches"]) \
+                        or not all(m is None or isinstance(m, dict)
+                                   for m in ms):
+                    n = len(ms) if hasattr(ms, "__len__") else type(ms)
+                    return self._refuse_update(
+                        job, sid, f"segment metrics length {n!r} != "
+                                  f"{len(job['minibatches'])}")
+            elif not (req.get("metrics") is None
+                      or isinstance(req.get("metrics"), dict)):
+                # a singleton job's metrics must be a dict (or absent):
+                # _feed_decision would raise on anything else, and the
+                # job — already popped — would be lost silently
+                return self._refuse_update(
+                    job, sid, "metrics payload is "
+                              f"{type(req.get('metrics')).__name__}, "
+                              "not a dict")
             if req.get("deltas"):
+                reason = self._quarantine_reason(req["deltas"])
+                if reason:
+                    return self._refuse_update(
+                        job, sid, f"delta quarantined: {reason}",
+                        counter="quarantined_updates", quarantined=True)
                 self.apply_deltas(req["deltas"])
             # async arrivals after completion must not rewind decision state
             if not bool(self.decision.complete):
@@ -310,7 +641,10 @@ class Server:
                     for mb, m in zip(job["minibatches"], ms):
                         self._feed_decision(mb, m or {})
                 else:
-                    self._feed_decision(job, req.get("metrics", {}))
+                    # `or {}`: a present-but-None metrics key passed the
+                    # type guard (None is legal) but must not reach
+                    # _feed_decision's .get calls
+                    self._feed_decision(job, req.get("metrics") or {})
             self.jobs_done += 1
             self.jobs_by_slave[sid] = self.jobs_by_slave.get(sid, 0) + 1
             return {"ok": True, "complete": bool(self.decision.complete)}

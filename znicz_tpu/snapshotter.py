@@ -411,18 +411,24 @@ class Snapshotter(Unit):
                 raise err
 
     def _write_host_format(self, path: str, snap: Dict) -> None:
-        # temp-file + atomic rename: a crash (or the daemon writer dying
-        # with the process) mid-dump must never truncate the previous
-        # good checkpoint — on-best saves exist for crash RECOVERY
-        tmp = path + ".tmp"
-        opener = gzip.open if self.compression == "gz" else open
-        try:
-            with opener(tmp, "wb") as f:
-                pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        write_host_pickle(path, snap, self.compression)
+
+
+def write_host_pickle(path: str, snap: Dict, compression: str = "gz") -> None:
+    """Atomic (temp file + rename) host-format snapshot write, shared by
+    the Snapshotter and the master's crash-resume file (server.py): a
+    crash — or the daemon writer dying with the process — mid-dump must
+    never truncate the previous good checkpoint; these files exist for
+    crash RECOVERY."""
+    tmp = path + ".tmp"
+    opener = gzip.open if compression == "gz" else open
+    try:
+        with opener(tmp, "wb") as f:
+            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 _ORBAX_CKPTR = None

@@ -73,16 +73,37 @@ class WebStatus:
             import time as _time
 
             now = _time.time()
+            srv = self.server
+            # C-level copies: the serve thread mutates these concurrently
+            # (evictions pop, updates append) and iterating the live
+            # structures from this HTTP thread could raise mid-request
+            live = dict(srv.slaves)
+            dead = dict(srv.dead_slaves)
             out["master"] = {
-                "endpoint": self.server.endpoint,
-                "jobs_done": self.server.jobs_done,
-                "jobs_requeued": self.server.jobs_requeued,
-                "stale_updates": self.server.stale_updates,
+                "endpoint": srv.endpoint,
+                "jobs_done": srv.jobs_done,
+                "jobs_requeued": srv.jobs_requeued,
+                "stale_updates": srv.stale_updates,
+                # robustness counters (fault model, README):
+                "bad_updates": srv.bad_updates,
+                "bad_frames": srv.bad_frames,
+                "quarantined_updates": srv.quarantined_updates,
+                "reregistrations": srv.reregistrations,
+                "resumed": bool(srv.resumed),
+                "resume_saves": srv.resume_saves,
+                "job_timeout_s": round(srv.effective_job_timeout(), 3),
                 "slaves": [
                     {"id": sid,
-                     "jobs": self.server.jobs_by_slave.get(sid, 0),
+                     "jobs": srv.jobs_by_slave.get(sid, 0),
                      "last_seen_s": round(now - seen, 1)}
-                    for sid, seen in sorted(self.server.slaves.items())],
+                    for sid, seen in sorted(live.items())],
+                # evicted-but-remembered membership (their job history
+                # survives for the final report)
+                "dead_slaves": [
+                    {"id": sid,
+                     "jobs": srv.jobs_by_slave.get(sid, 0),
+                     "last_seen_s": round(now - seen, 1)}
+                    for sid, seen in sorted(dead.items())],
             }
         return out
 
@@ -120,9 +141,17 @@ class WebStatus:
                             f"<h2>Master {html.escape(master['endpoint'])}"
                             f"</h2><p>jobs done: {master['jobs_done']}, "
                             f"re-queued: {master['jobs_requeued']}, stale "
-                            f"updates: {master['stale_updates']}</p>"
+                            f"updates: {master['stale_updates']}, bad "
+                            f"frames: {master['bad_frames']}, quarantined: "
+                            f"{master['quarantined_updates']}, reconnects: "
+                            f"{master['reregistrations']}, job timeout: "
+                            f"{master['job_timeout_s']}s"
+                            f"{', RESUMED' if master['resumed'] else ''}"
+                            "</p>"
                             "<table border=1><tr><th>slave</th><th>jobs"
-                            f"</th><th>last seen</th></tr>{srows}</table>")
+                            f"</th><th>last seen</th></tr>{srows}</table>"
+                            f"<p>dead slaves: {len(master['dead_slaves'])}"
+                            "</p>")
                     body = (
                         "<html><head><meta http-equiv='refresh' content='2'>"
                         "<title>znicz-tpu status</title></head><body>"
