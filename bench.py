@@ -57,6 +57,13 @@ kernel (znicz_tpu/pallas_fused_block.py).  The JSON line records the flag;
 a with/without pair on the same host is the BASELINE.md "Fused elementwise
 block" comparison.
 
+``python bench.py --wire`` instead microbenchmarks the v3 comms codec
+(znicz_tpu/parallel/wire.py) on an MNIST-shaped update payload: one JSON
+line with bytes/update, encode+decode ms and ratio vs the v2
+pickle wire, per wire dtype (f32/bf16/int8) plus the zlib'd params
+broadcast — the wire-cost record that rides the trajectory files
+alongside MFU (ISSUE 3).
+
 ``python bench.py --legacy`` re-runs the round-1 protocol (100-class head,
 256 resident images, FIXED minibatch indices) so the two protocols can be
 compared on the same host/build (ADVICE r2: the recorded r1 vs r2 numbers
@@ -711,6 +718,119 @@ def stream_main() -> None:
     }))
 
 
+#: --wire payload: the MNIST sample's trainable shapes (the same layer
+#: set the tests' master/slave runs ship every update), repeated TILE
+#: times so the codec is timed on a multi-MB payload, not cache noise
+WIRE_LAYER_SHAPES = {"fc1": {"weights": (784, 100), "bias": (100,)},
+                     "fc2": {"weights": (100, 10), "bias": (10,)}}
+WIRE_TILE = 8
+WIRE_REPS = 5
+
+
+def wire_main() -> None:
+    """``--wire``: comms-codec microbench.  Builds a synthetic update
+    (seeded normal deltas at MNIST layer shapes x WIRE_TILE + metrics
+    with a confusion matrix), measures encode+decode wall time and
+    bytes-on-wire per wire dtype against the v2 single-pickle wire, and
+    the zlib'd f32 params broadcast (the cold path).  Pure host-side —
+    no accelerator, no sockets — so the JSON line isolates codec cost
+    from transport and compute."""
+    import pickle
+    import time as _time
+
+    from znicz_tpu.parallel import wire
+
+    rng = np.random.default_rng(1013)
+    deltas = {}
+    for t in range(WIRE_TILE):
+        for name, layer in WIRE_LAYER_SHAPES.items():
+            deltas[f"{name}_t{t}"] = {
+                k: (rng.normal(0, 0.01, shape) * 0.1).astype(np.float32)
+                for k, shape in layer.items()}
+    metrics = {"loss": 1.0, "n_err": 3,
+               "confusion": rng.integers(0, 60, (10, 10))}
+    raw_bytes = sum(a.nbytes for layer in deltas.values()
+                    for a in layer.values())
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(WIRE_REPS):
+            t0 = _time.perf_counter()
+            out = fn()
+            best = min(best, _time.perf_counter() - t0)
+        return out, best * 1e3          # min over reps, in ms
+
+    def update_msg(enc_deltas):
+        return {"cmd": "update", "id": "bench", "job_id": 1,
+                "deltas": enc_deltas, "metrics": metrics}
+
+    # the v2 baseline: one pickle blob of the raw f32 update
+    blob, pickle_enc_ms = timed(
+        lambda: pickle.dumps(update_msg(deltas),
+                             pickle.HIGHEST_PROTOCOL))
+    _, pickle_dec_ms = timed(lambda: pickle.loads(blob))
+    v2_bytes = len(blob)
+
+    results = {"pickle_v2": {
+        "bytes_per_update": v2_bytes,
+        "encode_ms": round(pickle_enc_ms, 3),
+        "decode_ms": round(pickle_dec_ms, 3),
+        "ratio_vs_pickle_v2": 1.0}}
+    for dtype in ("float32", "bfloat16", "int8"):
+        enc = wire.DeltaEncoder(dtype)
+
+        def encode():
+            frames, _ = wire.encode_message(update_msg(enc.encode(deltas)))
+            return frames
+        frames, enc_ms = timed(encode)
+        frames = [bytes(f) for f in frames]     # what the peer receives
+        (dec, _), dec_ms = timed(lambda: wire.decode_message(frames))
+        n_bytes = sum(len(f) for f in frames)
+        err = max(float(np.max(np.abs(dec["deltas"][name][k]
+                                      - deltas[name][k])))
+                  for name in deltas for k in deltas[name])
+        results[dtype] = {
+            "bytes_per_update": n_bytes,
+            "encode_ms": round(enc_ms, 3),
+            "decode_ms": round(dec_ms, 3),
+            "ratio_vs_pickle_v2": round(v2_bytes / n_bytes, 3),
+            "max_abs_err": float(f"{err:.3e}"),
+        }
+
+    # cold path: the f32 params broadcast, zlib'd (fresh-init weights
+    # compress well; converged ones less — this records the mechanism)
+    bcast = {"job_id": 1, "params": deltas}
+    frames, enc_ms = timed(
+        lambda: wire.encode_message(bcast, compress="zlib")[0])
+    frames = [bytes(f) for f in frames]
+    _, dec_ms = timed(lambda: wire.decode_message(frames))
+    plain = sum((bytes(f).__len__())
+                for f in wire.encode_message(bcast)[0])
+    results["params_zlib"] = {
+        "bytes": sum(len(f) for f in frames),
+        "encode_ms": round(enc_ms, 3),
+        "decode_ms": round(dec_ms, 3),
+        "ratio_vs_raw": round(plain / sum(len(f) for f in frames), 3),
+    }
+
+    print(json.dumps({
+        "metric": "wire_codec_bytes_per_update_int8",
+        "value": results["int8"]["bytes_per_update"],
+        "unit": "bytes",
+        "vs_baseline": results["int8"]["ratio_vs_pickle_v2"],
+        "payload_f32_mb": round(raw_bytes / 2**20, 3),
+        "tensors": sum(len(v) for v in deltas.values()),
+        "wire": results,
+    }))
+    # the acceptance floor (ISSUE 3): int8 must beat the pickle wire by
+    # >= 3.5x on this payload; enforced AFTER the JSON line so a tripped
+    # gate never destroys the measurement it complains about
+    if results["int8"]["ratio_vs_pickle_v2"] < 3.5:
+        raise SystemExit(
+            f"int8 wire ratio {results['int8']['ratio_vs_pickle_v2']} "
+            "fell below the 3.5x floor vs the v2 pickle wire")
+
+
 def _gd_finals(decision) -> dict:
     from znicz_tpu.loader.base import TRAIN, VALID
 
@@ -825,6 +945,8 @@ if __name__ == "__main__":
         HEADLINE_GUARDS = False
     if "--samples" in args:
         measure_samples()
+    elif "--wire" in args:
+        wire_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

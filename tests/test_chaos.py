@@ -138,25 +138,67 @@ def test_chaos_proxy_faults_accounted(tmp_path):
     assert proxy.total_faults() > 0
     for action in ("drop", "corrupt", "dup", "delay"):
         assert c["req"][action] + c["rep"][action] > 0, c
-    # every corrupted request was refused + counted by the master
+    # every corrupted request was refused + counted by the master —
+    # v3 framing included: whichever payload frame the proxy mutated
+    # (metadata or a tensor buffer), the codec detected it
     assert server.bad_frames == c["req"]["corrupt"], (server.bad_frames, c)
-    # every corrupted reply was detected + counted by a slave.  A dup
+    # every corrupted reply was detected + counted by a slave (main
+    # socket or its prefetcher — both decode through the codec).  A dup
     # spawns one EXTRA reply the client's REQ_CORRELATE discards unseen;
     # a later drop/corrupt decision can land on that ghost frame, so the
     # client-side counters may undercount by at most the dup count.
     dups = c["req"]["dup"] + c["rep"]["dup"]
-    bad_replies = sum(s.bad_replies for s in slaves)
+    bad_replies = sum(s.bad_replies + s.prefetch_bad_replies
+                      for s in slaves)
     assert c["rep"]["corrupt"] - dups <= bad_replies <= c["rep"]["corrupt"]
-    # every starved receive became a reconnect (fresh socket + backoff);
+    # every starved receive became a fresh-socket retry on whichever
+    # socket starved (main loop reconnect or prefetcher reconnect);
     # slack below for ghost-frame absorption, above for endgame retries
-    # after the master's linger expires
+    # after the master's linger expires (one per socket, two sockets per
+    # slave since the v3 prefetch pipeline)
     starved = proxy.faults_toward("rep")
-    reconnects = sum(s.reconnects for s in slaves)
-    assert starved - dups <= reconnects <= starved + 3 * len(slaves), \
+    reconnects = sum(s.reconnects + s.prefetch_reconnects for s in slaves)
+    assert starved - dups <= reconnects <= starved + 4 * len(slaves), \
         (starved, reconnects, c)
     # books balance: every accepted update is attributed to a slave
     assert server.jobs_done == sum(server.jobs_by_slave.values())
     assert all(server.jobs_by_slave.get(s.slave_id, 0) > 0 for s in slaves)
+
+
+def test_chaos_corruption_is_multipart_aware():
+    """v3 framing (ISSUE 3 satellite): one fault decision covers the
+    WHOLE logical multipart message, the mutation lands on exactly one
+    PAYLOAD frame (metadata or a tensor buffer — never the ROUTER
+    routing envelope, so refusals still route back), the pick is a pure
+    function of (seed, frame_no), and whatever frame it lands on the
+    codec detects the damage."""
+    import numpy as np_
+
+    from znicz_tpu.parallel import wire
+    from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
+
+    proxy = ChaosProxy("inproc://cfront", "inproc://cback",
+                       FaultSchedule(SEED, **CHAOS))   # never started
+    payload, _ = wire.encode_message(
+        {"cmd": "update", "id": "s1", "job_id": 7,
+         "deltas": {"l": {"w": np_.ones((8, 8), np_.float32)}},
+         "metrics": {"loss": 1.0}})
+    payload = [bytes(f) for f in payload]
+    envelope = [b"identity", b"\x00\x00\x00\x01", b""]  # id+correlate+delim
+    frames = envelope + payload
+    picks = set()
+    for fno in range(60):
+        out1 = proxy._corrupt_one(list(frames), fno)
+        assert out1 == proxy._corrupt_one(list(frames), fno)  # determinism
+        assert out1[:len(envelope)] == envelope     # envelope untouched
+        changed = [i for i, (a, b) in enumerate(zip(out1, frames))
+                   if a != b]
+        assert len(changed) == 1 and changed[0] >= len(envelope), changed
+        picks.add(changed[0])
+        with pytest.raises(wire.WireError):
+            wire.decode_message(out1[len(envelope):])
+    # over many frames the pick really ranges over ALL payload frames
+    assert picks == set(range(len(envelope), len(frames))), picks
 
 
 # -- slave kill + master kill/resume -------------------------------------------
@@ -432,7 +474,11 @@ def test_client_reconnects_with_fresh_socket_after_timeout(tmp_path):
 
     def scripted_master():
         """ROUTER-based master: replies to everything EXCEPT the first
-        job request, which it swallows (a dropped reply)."""
+        job request, which it swallows (a dropped reply).  Decodes v3
+        multipart requests and answers in legacy pickle framing — the
+        client must accept both (lenient decode)."""
+        from znicz_tpu.parallel import wire
+
         ctx = zmq.Context.instance()
         router = ctx.socket(zmq.ROUTER)
         router.setsockopt(zmq.RCVTIMEO, 20_000)
@@ -441,8 +487,9 @@ def test_client_reconnects_with_fresh_socket_after_timeout(tmp_path):
         try:
             ignored_job = False
             while True:
-                frames = router.recv_multipart()
-                req = pickle.loads(frames[-1])
+                envelope, payload = wire.split_envelope(
+                    router.recv_multipart())
+                req, _ = wire.decode_message(payload)
                 seen.append(req["cmd"])
                 if req["cmd"] == "job" and not ignored_job:
                     ignored_job = True
@@ -452,7 +499,7 @@ def test_client_reconnects_with_fresh_socket_after_timeout(tmp_path):
                            "class_lengths": [0, 60, 300]}
                 elif req["cmd"] == "job":
                     rep = {"done": True}
-                router.send_multipart(frames[:-1] + [pickle.dumps(rep)])
+                router.send_multipart(envelope + [pickle.dumps(rep)])
                 if req["cmd"] == "job":
                     return
         finally:
@@ -482,17 +529,20 @@ def test_client_gives_up_cleanly_when_master_gone(tmp_path):
     wf = _make_workflow(tmp_path / "s")
 
     def register_then_die():
+        from znicz_tpu.parallel import wire
+
         ctx = zmq.Context.instance()
         router = ctx.socket(zmq.ROUTER)
         router.setsockopt(zmq.RCVTIMEO, 20_000)
         router.setsockopt(zmq.LINGER, 0)
         router.bind(endpoint)
         try:
-            frames = router.recv_multipart()
-            req = pickle.loads(frames[-1])
+            envelope, payload = wire.split_envelope(
+                router.recv_multipart())
+            req, _ = wire.decode_message(payload)
             rep = {"ok": True, "version": req["version"],
                    "class_lengths": [0, 60, 300]}
-            router.send_multipart(frames[:-1] + [pickle.dumps(rep)])
+            router.send_multipart(envelope + [pickle.dumps(rep)])
         finally:
             router.close(0)              # master gone for good
 

@@ -206,8 +206,6 @@ def test_handshake_version_mismatch_refused(tmp_path):
 def test_handshake_digest_mismatch_refused_client_side(tmp_path):
     """A slave running a DIFFERENT config raises a clean error instead of
     training against incompatible weights."""
-    import pickle
-
     import zmq
 
     from znicz_tpu.client import Client
@@ -217,7 +215,8 @@ def test_handshake_digest_mismatch_refused_client_side(tmp_path):
     master_wf = _make_workflow(tmp_path / "m")
     server = Server(master_wf, endpoint=endpoint)
 
-    # master thread: answer exactly one request, then exit
+    # master thread: answer exactly one request, then exit (the server's
+    # own v3 frame path, minus the serve loop)
     def one_reply():
         import zmq as _zmq
 
@@ -225,8 +224,8 @@ def test_handshake_digest_mismatch_refused_client_side(tmp_path):
         sock = ctx.socket(_zmq.REP)
         sock.bind(endpoint)
         try:
-            req = pickle.loads(sock.recv())
-            sock.send(pickle.dumps(server._handle(req)))
+            sock.send_multipart(
+                server._reply_frames(sock.recv_multipart()))
         finally:
             sock.close(0)
 

@@ -10,14 +10,25 @@ REQ socket is stuck in a broken EFSM state and can NEVER be reused, so
 every retry closes it and connects a FRESH one, waits a capped
 exponential backoff with deterministic per-slave jitter, and re-registers
 before any further job traffic.  That lets a slave ride out frame loss,
-garbage replies, AND a full master restart (``--master-resume``)."""
+garbage replies, AND a full master restart (``--master-resume``).
+
+Wire protocol v3 (parallel/wire.py, ISSUE 3): every message is multipart
+— metadata frame + zero-copy tensor frames; weight deltas are quantized
+to ``root.common.engine.wire_dtype`` (bf16/int8 with per-tensor absmax
+scales) through a :class:`wire.DeltaEncoder` whose error-feedback
+residuals keep convergence at f32 parity; a pending update is stored as
+its ALREADY-ENCODED frames, so a resend after a reconnect re-sends bytes
+instead of re-serializing the whole delta set.  A second socket on a
+:class:`_JobPrefetcher` thread fetches job N+1 while the trainer
+computes job N (``root.common.engine.job_prefetch``), hiding the fetch
+round trip behind compute."""
 
 from __future__ import annotations
 
-import pickle
+import threading
 import time
 import uuid
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -25,8 +36,143 @@ from znicz_tpu.loader.base import TRAIN
 
 
 class _BadReply(Exception):
-    """A reply frame that did not decode to a dict (truncated/corrupt) —
-    handled exactly like a timeout: fresh socket, backoff, re-register."""
+    """A reply frame stack that did not decode to a dict (truncated or
+    corrupt) — handled exactly like a timeout: fresh socket, backoff,
+    re-register."""
+
+
+class _JobPrefetcher:
+    """Pipelined job fetch (ISSUE 3): while the trainer computes job N,
+    this thread requests job N+1 on its OWN REQ socket (ZMQ sockets are
+    not thread-safe), so the fetch round trip — params broadcast
+    included — overlaps compute instead of serializing with it.
+
+    At most one fetch is ever outstanding; ``request()`` arms it,
+    ``take()`` collects the decoded reply (or None on a miss).  A
+    transport fault on THIS socket never touches the main loop's
+    reconnect state machine: the prefetcher closes its (EFSM-broken)
+    socket, counts ``prefetch_reconnects``/``prefetch_bad_replies`` on
+    the client, and the main socket simply fetches the job itself.
+
+    Semantics note: job N+1 is issued while update N is still local, so
+    its params snapshot misses this slave's own last delta — delay-1
+    staleness, the same kind the async protocol already exhibits
+    whenever two slaves interleave (and what the seeded parity band in
+    tests/test_wire.py covers).  A strictly sequential single-slave
+    trajectory needs ``root.common.engine.job_prefetch = False``."""
+
+    def __init__(self, client: "Client", connect, recv_timeout: float):
+        self._client = client
+        self._connect = connect         # () -> fresh connected REQ socket
+        self._recv_timeout = float(recv_timeout)
+        self._want = threading.Event()
+        self._ready = threading.Event()
+        self._slot: Optional[dict] = None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"job-prefetch-{client.slave_id}")
+        self._thread.start()
+
+    def request(self) -> None:
+        """Arm one fetch; no-op while one is pending/unconsumed."""
+        if self._want.is_set() or self._ready.is_set():
+            return
+        self._slot = None
+        self._want.set()
+
+    def pending(self) -> bool:
+        return self._want.is_set() or self._ready.is_set()
+
+    #: how long take() is willing to wait for an in-flight fetch to land
+    #: — on loopback/LAN the reply beat the compute anyway, and when it
+    #: did NOT (dropped frame: the fetch thread sits out its full recv
+    #: timeout) the main loop must fall back to its own healthy socket
+    #: after a BOUNDED stall, not idle ~recv_timeout per fault
+    TAKE_GRACE_S = 0.25
+
+    def take(self) -> Optional[dict]:
+        """The fetched job reply, or None (nothing armed, fetch failed,
+        or still in flight past the grace).  A fetch that resolves
+        AFTER a miss is not wasted: it stays in the slot — a real job
+        assignment the next take() consumes (one compute-round of extra
+        age, well inside the master's adaptive reap window)."""
+        if not self.pending():
+            return None
+        if not self._ready.wait(min(self.TAKE_GRACE_S,
+                                    self._recv_timeout)):
+            return None                 # in flight: main socket takes over
+        rep, self._slot = self._slot, None
+        self._ready.clear()
+        return rep
+
+    def stop(self) -> None:
+        self._stop = True
+        self._want.set()
+        self._thread.join(self._recv_timeout + 5.0)
+
+    def _loop(self) -> None:
+        import zmq
+
+        from znicz_tpu.parallel import wire
+
+        sock = None
+        try:
+            # _stop is re-checked at the TOP of every lap: stop() can
+            # land while a fetch is in flight, and that fetch's finally
+            # clears _want — checking _stop only after wait() would then
+            # block here forever (the stop signal rides _stop, _want is
+            # just the wake-up)
+            while not self._stop:
+                self._want.wait()
+                if self._stop:
+                    break
+                rep = None
+                try:
+                    if sock is None:
+                        sock = self._connect()
+                    frames, _ = wire.encode_message(
+                        {"cmd": "job", "prefetch": True,
+                         "id": self._client.slave_id})
+                    rep = self._client._rpc_frames(sock, frames)
+                except zmq.Again:
+                    # starved receive: same EFSM rule as the main loop —
+                    # the socket can never be reused; reconnect fresh on
+                    # the next fetch
+                    self._client.prefetch_reconnects += 1
+                    if sock is not None:
+                        sock.close(0)
+                        sock = None
+                except _BadReply:
+                    # undecodable reply: count it (the chaos accounting
+                    # holds bad-reply counters to the corrupt-frame
+                    # count, so ONLY real replies may tick this) and
+                    # mirror the main loop's fresh-socket policy
+                    self._client.prefetch_bad_replies += 1
+                    self._client.prefetch_reconnects += 1
+                    if sock is not None:
+                        sock.close(0)
+                        sock = None
+                except Exception:
+                    # connect/send fault or a genuine bug: never a
+                    # "bad reply" — log it (a silently-spinning
+                    # prefetcher would be undiagnosable) and refresh
+                    import logging
+
+                    logging.getLogger("znicz").warning(
+                        "%s: prefetch fetch failed", self._client.slave_id,
+                        exc_info=True)
+                    self._client.prefetch_reconnects += 1
+                    if sock is not None:
+                        sock.close(0)
+                        sock = None
+                finally:
+                    self._slot = rep
+                    self._want.clear()
+                    self._ready.set()
+        finally:
+            if sock is not None:        # closed by the owning thread
+                sock.close(0)
 
 
 class Client:
@@ -36,15 +182,30 @@ class Client:
         self.endpoint = endpoint
         self.slave_id = slave_id or uuid.uuid4().hex[:8]
         self.jobs_done = 0
-        self.reconnects = 0             # fresh-socket retries taken
-        self.bad_replies = 0            # undecodable reply frames seen
+        self.reconnects = 0             # fresh-socket retries (main loop)
+        self.bad_replies = 0            # undecodable replies (main loop)
+        self.prefetch_hits = 0          # jobs consumed from the prefetcher
+        self.prefetch_reconnects = 0    # fresh-socket retries (prefetcher)
+        self.prefetch_bad_replies = 0   # undecodable replies (prefetcher)
+        self.wire_dtype = "float32"     # resolved from config in run()
+        self._delta_encoder = None
 
     def _rpc(self, sock, msg: dict) -> dict:
+        from znicz_tpu.parallel import wire
+
         msg["id"] = self.slave_id
-        sock.send(pickle.dumps(msg))
-        raw = sock.recv()               # zmq.Again propagates
+        frames, _ = wire.encode_message(msg)
+        return self._rpc_frames(sock, frames)
+
+    def _rpc_frames(self, sock, frames: List) -> dict:
+        """One REQ/REP exchange of already-encoded v3 frames (the resend
+        path re-sends these exact bytes — no re-serialization)."""
+        from znicz_tpu.parallel import wire
+
+        sock.send_multipart(frames, copy=False)
+        raw = sock.recv_multipart()     # zmq.Again propagates
         try:
-            rep = pickle.loads(raw)
+            rep, _ = wire.decode_message(raw)
             if not isinstance(rep, dict):
                 raise TypeError(f"reply decodes to {type(rep).__name__}")
         except Exception as exc:
@@ -137,7 +298,14 @@ class Client:
         ``connect_retries`` bounds only the FIRST contact, so a slave
         pointed at a dead endpoint still fails fast with ConnectionError.
         Defaults come from root.common.engine.slave_reconnects /
-        slave_backoff_base / slave_backoff_cap."""
+        slave_backoff_base / slave_backoff_cap.
+
+        v3 pipeline: while a job computes, a :class:`_JobPrefetcher`
+        thread fetches the next one on a second socket
+        (root.common.engine.job_prefetch, default on), and the pending
+        update is kept as its encoded frames so a resend after a
+        reconnect ships the same bytes.  Deltas go out quantized per
+        root.common.engine.wire_dtype with error-feedback residuals."""
         import logging
         import random
 
@@ -146,6 +314,7 @@ class Client:
         from znicz_tpu.core.config import root
         from znicz_tpu.lr_adjust import LearningRateAdjust
         from znicz_tpu.network_common import handshake_request
+        from znicz_tpu.parallel import wire
 
         eng = root.common.engine
         if max_reconnects is None:
@@ -154,6 +323,12 @@ class Client:
             backoff_base = float(eng.get("slave_backoff_base", 0.25))
         if backoff_cap is None:
             backoff_cap = float(eng.get("slave_backoff_cap", 5.0))
+        # wire-v3 knobs: delta quantization (error-feedback residuals
+        # live in the encoder, one per tensor) and the job prefetcher
+        self.wire_dtype = wire.canonical_wire_dtype(
+            eng.get("wire_dtype", "float32"))
+        self._delta_encoder = wire.DeltaEncoder(self.wire_dtype)
+        prefetch_on = bool(eng.get("job_prefetch", True))
         log = logging.getLogger("znicz")
 
         if any(isinstance(u, LearningRateAdjust)
@@ -177,7 +352,11 @@ class Client:
         failures = 0                    # CONSECUTIVE transport failures
         refusals = 0                    # CONSECUTIVE bad_frame replies
         refusal_cap = max(3, max_reconnects)
-        update_msg: Optional[dict] = None
+        #: the pending update as ALREADY-ENCODED v3 frames — a resend
+        #: after a reconnect re-sends these bytes, it does not re-pickle
+        #: or re-quantize anything (ISSUE 3 satellite)
+        update_frames: Optional[list] = None
+        prefetcher: Optional[_JobPrefetcher] = None
 
         def refused() -> bool:
             """A bad_frame reply means the master is alive but never
@@ -245,9 +424,9 @@ class Client:
                             f"{rep.get('error')}")
                     registered = ever_registered = True
                     continue
-                if update_msg is not None:
+                if update_frames is not None:
                     try:
-                        rep = self._rpc(sock, update_msg)
+                        rep = self._rpc_frames(sock, update_frames)
                     except (zmq.Again, _BadReply) as exc:
                         if not reconnect(exc):
                             break
@@ -264,16 +443,25 @@ class Client:
                     if rep.get("quarantined"):
                         log.warning("%s: master quarantined our delta: %s",
                                     self.slave_id, rep.get("error"))
-                    update_msg = None
+                    update_frames = None
                     self.jobs_done += 1
                     continue
-                try:
-                    rep = self._rpc(sock, {"cmd": "job"})
-                except (zmq.Again, _BadReply) as exc:
-                    if not reconnect(exc):
-                        break
-                    continue
-                failures = 0
+                # -- next job: the prefetcher's pipelined fetch first ----
+                rep = None
+                if prefetcher is not None:
+                    rep = prefetcher.take()
+                    if rep is not None:
+                        failures = 0    # a reply is a reply: master alive
+                        if "job" in rep:
+                            self.prefetch_hits += 1
+                if rep is None:
+                    try:
+                        rep = self._rpc(sock, {"cmd": "job"})
+                    except (zmq.Again, _BadReply) as exc:
+                        if not reconnect(exc):
+                            break
+                        continue
+                    failures = 0
                 if rep.get("bad_frame"):
                     if refused():
                         break
@@ -288,15 +476,28 @@ class Client:
                     time.sleep(poll_sleep)     # wait: master re-asks soon
                     continue
                 job, params = rep["job"], rep["params"]
+                if prefetch_on and prefetcher is None:
+                    # started lazily on the FIRST real job, so a run the
+                    # master refuses (or never serves) spawns no thread
+                    prefetcher = _JobPrefetcher(
+                        self, lambda: self._connect(ctx, timeout_ms),
+                        recv_timeout)
+                if prefetcher is not None:
+                    prefetcher.request()   # fetch job N+1 during compute
                 self._apply_params(params)
                 before = {name: {k: np.asarray(v) for k, v in layer.items()}
                           for name, layer in params.items()}
                 train = bool(rep.get("train"))
                 metrics = self._run_minibatch(job, train)
                 deltas = self._deltas_since(before) if train else None
-                update_msg = {"cmd": "update", "job_id": rep["job_id"],
-                              "deltas": deltas, "metrics": metrics}
+                update_frames, _ = wire.encode_message(
+                    {"cmd": "update", "id": self.slave_id,
+                     "job_id": rep["job_id"],
+                     "deltas": self._delta_encoder.encode(deltas),
+                     "metrics": metrics})
         finally:
+            if prefetcher is not None:
+                prefetcher.stop()
             sock.close(0)
         return self.jobs_done
 

@@ -28,6 +28,12 @@ class Distributable:
     negotiates_on_connect = False
 
     def _param_arrays(self) -> Dict[str, "np.ndarray"]:
+        # independent C-contiguous COPIES, on purpose: wire protocol v3
+        # (parallel/wire.py) ships each as one raw zero-copy buffer frame
+        # that may still be queued in ZMQ (send copy=False) while the
+        # live param Arrays are already being mutated by the next
+        # apply_deltas — aliasing the live memory here would tear the
+        # payload on the wire
         params = getattr(self, "params", None)
         if callable(params):
             return {k: np.array(a.map_read())

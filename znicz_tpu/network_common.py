@@ -7,8 +7,9 @@ the rebuild's equivalent is a version + workflow-digest exchange on the
 or a different trainable graph is refused with a human-readable reason
 instead of corrupting weights mid-training (VERDICT r2 missing #5).
 
-Payloads stay pickle-over-ZMQ like the reference (trusted-cluster
-assumption, documented in server.py).
+Since protocol v3, payloads are MULTIPART tensor frames (metadata +
+zero-copy buffers, parallel/wire.py); only the small metadata frame
+stays pickle (trusted-cluster assumption, documented in server.py).
 """
 
 from __future__ import annotations
@@ -25,7 +26,15 @@ from typing import Optional
 #: ``{"quarantined": True}``; the register reply carries ``resumed`` and
 #: ``epoch`` so a reconnecting slave can tell a crash-resumed master from
 #: a fresh one.
-PROTOCOL_VERSION = 2
+#: v3 (wire rev, parallel/wire.py): messages are ZMQ multipart — one
+#: metadata frame (command + tensor manifest: names/shapes/dtypes/
+#: scales) plus one raw zero-copy buffer frame per tensor; deltas may be
+#: bf16/int8 with per-tensor absmax scales + client-side error-feedback
+#: residuals; params broadcasts may be zlib/lz4-compressed.  A v2 peer
+#: (single-pickle framing, version 2) is refused at register with a
+#: reason it can still decode (the master answers legacy-framed requests
+#: in legacy framing).
+PROTOCOL_VERSION = 3
 
 
 #: structural attributes that define a unit's computation (beyond its
@@ -107,8 +116,11 @@ def check_handshake(req: dict, workflow) -> Optional[str]:
     reason, or None when the peer is compatible."""
     v = req.get("version")
     if v != PROTOCOL_VERSION:
+        hint = (" — v2 speaks the single-frame pickle wire; upgrade the "
+                "slave to the v3 multipart tensor-frame wire"
+                if v == 2 else "")
         return (f"protocol version mismatch: master speaks "
-                f"{PROTOCOL_VERSION}, slave sent {v!r}")
+                f"{PROTOCOL_VERSION}, slave sent {v!r}{hint}")
     theirs = req.get("workflow_digest")
     mine = workflow_digest(workflow)
     if theirs != mine:

@@ -8,10 +8,13 @@ Three pieces:
     seed produce IDENTICAL fault schedules (the CI determinism contract);
   - :class:`ChaosProxy`: a ZeroMQ ROUTER<->DEALER proxy between REQ
     slaves and the REP master that drops, delays, duplicates, and
-    corrupts frames per the schedule.  Only the LAST frame (the pickle
-    payload) is ever corrupted — the routing envelope stays intact, so a
-    refusal reply still finds its way back to the broken peer.  Every
-    decision is counted per direction (``req`` = slave->master, ``rep`` =
+    corrupts frames per the schedule.  Fault decisions apply to WHOLE
+    logical messages (one decision per multipart stack, v3-aware), and
+    corruption mutates exactly one PAYLOAD frame — the v3 metadata frame
+    or one of the raw tensor frames, chosen deterministically from
+    (seed, frame_no) — never the ROUTER routing envelope, so a refusal
+    reply still finds its way back to the broken peer.  Every decision
+    is counted per direction (``req`` = slave->master, ``rep`` =
     master->slave) and logged, so a test can hold the master's/slaves'
     robustness counters to account for every injected fault;
   - process-level kill harnesses: :func:`take_job_and_die` (a slave that
@@ -89,7 +92,12 @@ class FaultSchedule:
 
 def corrupt_payload(payload: bytes) -> bytes:
     """Deterministic frame corruption: truncate to a third and flip the
-    first byte — reliably undecodable by pickle, like a torn write."""
+    first byte — reliably undecodable (a torn pickle, or a tensor frame
+    whose length no longer matches its v3 manifest entry).  An empty
+    frame (a zero-length tensor buffer) grows a poison byte instead —
+    still a guaranteed manifest-length mismatch."""
+    if not payload:
+        return b"\xff"
     cut = max(1, len(payload) // 3)
     head = bytearray(payload[:cut])
     head[0] ^= 0xFF
@@ -151,6 +159,24 @@ class ChaosProxy:
 
     # -- the relay loop --------------------------------------------------------
 
+    def _corrupt_one(self, frames: List[bytes], frame_no: int
+                     ) -> List[bytes]:
+        """Multipart-aware corruption (v3 framing): mutate exactly ONE
+        payload frame — metadata or any tensor buffer, picked as a pure
+        function of (seed, frame_no) — and never the routing envelope
+        (peer identity / REQ correlate id / empty delimiter), so the
+        refusal reply can still be routed back."""
+        from znicz_tpu.parallel.wire import split_envelope
+
+        envelope, payload = split_envelope(frames)
+        if not payload:                 # degenerate: nothing to corrupt
+            return frames
+        pick = int(np.random.default_rng(
+            (self.schedule.seed, int(frame_no), 0xC0))
+            .integers(len(payload)))
+        payload[pick] = corrupt_payload(payload[pick])
+        return envelope + payload
+
     def _loop(self) -> None:
         import zmq
 
@@ -181,14 +207,15 @@ class ChaosProxy:
                     frames = sock.recv_multipart()
                     direction = "req" if sock is front else "rep"
                     out = back if sock is front else front
-                    action, delay = self.schedule.decide(self._frame_no)
+                    fno = self._frame_no
+                    action, delay = self.schedule.decide(fno)
                     self.counters[direction][action] += 1
-                    self.log.append((self._frame_no, direction, action))
+                    self.log.append((fno, direction, action))
                     self._frame_no += 1
                     if action == "drop":
                         continue
                     if action == "corrupt":
-                        frames = frames[:-1] + [corrupt_payload(frames[-1])]
+                        frames = self._corrupt_one(frames, fno)
                         out.send_multipart(frames)
                     elif action == "dup":
                         out.send_multipart(frames)
@@ -214,27 +241,28 @@ def take_job_and_die(endpoint: str, workflow, slave_id: str = "doomed",
     — it must come back via the reaper (``jobs_requeued``) for the
     no-silent-loss property to hold — or None if training already ended.
     """
-    import pickle
-
     import zmq
 
     from znicz_tpu.network_common import handshake_request
+    from znicz_tpu.parallel import wire
 
     ctx = zmq.Context.instance()
     sock = ctx.socket(zmq.REQ)
     sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
     sock.setsockopt(zmq.LINGER, 0)
     sock.connect(endpoint)
+
+    def rpc(msg: dict) -> dict:
+        frames, _ = wire.encode_message(dict(msg, id=slave_id))
+        sock.send_multipart(frames)
+        return wire.decode_message(sock.recv_multipart())[0]
+
     try:
-        msg = handshake_request(workflow)
-        msg["id"] = slave_id
-        sock.send(pickle.dumps(msg))
-        rep = pickle.loads(sock.recv())
+        rep = rpc(handshake_request(workflow))
         if not rep.get("ok"):
             raise RuntimeError(f"registration refused: {rep.get('error')}")
         while True:
-            sock.send(pickle.dumps({"cmd": "job", "id": slave_id}))
-            rep = pickle.loads(sock.recv())
+            rep = rpc({"cmd": "job"})
             if "job" in rep:
                 return rep["job_id"]
             if rep.get("done"):

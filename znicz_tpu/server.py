@@ -14,8 +14,15 @@ a mesh:
   - slave join/leave is inherently elastic: a lost job is re-queued after
     ``job_timeout``.
 
-Transport is pyzmq REP with pickle payloads, mirroring the reference's
-pickle-over-ZMQ (trusted-cluster assumption documented there too).
+Transport is pyzmq REP speaking wire protocol v3 (parallel/wire.py):
+multipart messages — one small pickled metadata frame plus one raw
+zero-copy buffer frame per tensor, with optional bf16/int8 delta
+quantization (decoded transparently here, so quarantine inspects REAL
+deltas) and optional zlib/lz4 compression of the params broadcast
+(``root.common.engine.wire_compress``).  Only the metadata frame is
+pickle (trusted-cluster assumption, like the reference's wire).  A peer
+still framing v2 (one pickled blob) gets its reply — including the
+protocol-version refusal — in v2 framing so it can read the reason.
 """
 
 from __future__ import annotations
@@ -83,6 +90,23 @@ class Server:
         self.quarantined_updates = 0    # non-finite / norm-exploded deltas
         self.reregistrations = 0        # re-registers (slave reconnects)
         self.resume_saves = 0           # crash-resume snapshots written
+        # -- wire-v3 traffic accounting (ISSUE 3) --------------------------
+        self.bytes_in = 0               # wire bytes received (all frames)
+        self.bytes_out = 0              # wire bytes sent (all frames)
+        self.updates_received = 0       # update messages seen (any outcome)
+        self.update_bytes_in = 0        # wire bytes of those updates
+        self.prefetch_hit = 0           # jobs served to prefetch requests
+        # f32-equivalent vs actual tensor bytes, per direction: ``in`` is
+        # dominated by (possibly quantized) deltas, ``out`` by the
+        # (possibly compressed) params broadcast
+        self.tensor_bytes_raw_in = 0
+        self.tensor_bytes_wire_in = 0
+        self.tensor_bytes_raw_out = 0
+        self.tensor_bytes_wire_out = 0
+        #: cold-path compression of the params broadcast ("none"/"zlib"/
+        #: "lz4"); deltas are quantized by the CLIENT (engine.wire_dtype)
+        self.wire_compress = str(
+            root.common.engine.get("wire_compress", "none"))
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
@@ -139,6 +163,29 @@ class Server:
                     mem += d[k]
 
     # -- job management --------------------------------------------------------
+
+    def compression_ratio(self, direction: str = "both"
+                          ) -> Optional[float]:
+        """f32-equivalent tensor bytes / tensor bytes actually on the
+        wire — ``"in"`` (quantized deltas), ``"out"`` (optionally
+        compressed params broadcast) or ``"both"``; None before any
+        tensor traffic in that direction."""
+        raw = ((self.tensor_bytes_raw_in if direction != "out" else 0)
+               + (self.tensor_bytes_raw_out if direction != "in" else 0))
+        cooked = ((self.tensor_bytes_wire_in if direction != "out" else 0)
+                  + (self.tensor_bytes_wire_out if direction != "in"
+                     else 0))
+        if not cooked:
+            return None
+        return raw / cooked
+
+    def bytes_per_update(self) -> Optional[float]:
+        """Mean wire bytes of one slave->master update message — the
+        acceptance metric the int8 wire must beat the f32/pickle wire on
+        (ISSUE 3); None before the first update."""
+        if not self.updates_received:
+            return None
+        return self.update_bytes_in / self.updates_received
 
     def effective_job_timeout(self) -> float:
         """The reap timeout, adapted from observed job durations: the
@@ -376,6 +423,15 @@ class Server:
                 "bad_frames": self.bad_frames,
                 "quarantined_updates": self.quarantined_updates,
                 "reregistrations": self.reregistrations,
+                "bytes_in": self.bytes_in,
+                "bytes_out": self.bytes_out,
+                "updates_received": self.updates_received,
+                "update_bytes_in": self.update_bytes_in,
+                "prefetch_hit": self.prefetch_hit,
+                "tensor_bytes_raw_in": self.tensor_bytes_raw_in,
+                "tensor_bytes_wire_in": self.tensor_bytes_wire_in,
+                "tensor_bytes_raw_out": self.tensor_bytes_raw_out,
+                "tensor_bytes_wire_out": self.tensor_bytes_wire_out,
             },
         }
         # compression keyed to the extension: Snapshotter.load picks its
@@ -490,8 +546,15 @@ class Server:
                 self._evict_dead_slaves()
                 self._maybe_save_resume()
                 if poller.poll(100):
-                    rep = self._reply(self._socket.recv())
-                    self._socket.send(pickle.dumps(rep))
+                    frames = self._socket.recv_multipart()
+                    self.bytes_in += sum(len(f) for f in frames)
+                    rep_frames = self._reply_frames(frames)
+                    self.bytes_out += sum(
+                        f.nbytes if isinstance(f, memoryview) else len(f)
+                        for f in rep_frames)
+                    # copy=False: reply tensor frames are memoryviews of
+                    # snapshot_params' fresh copies, never mutated later
+                    self._socket.send_multipart(rep_frames, copy=False)
         finally:
             self._socket.close(0)
             self._socket = None
@@ -503,33 +566,55 @@ class Server:
                 # command would silently restore stale mid-training state
                 os.remove(self.resume_path)
 
-    def _reply(self, raw: bytes) -> dict:
-        """Decode + dispatch one frame.  NEVER raises: a truncated or
-        garbage frame from a broken peer — or a request that decodes but
+    def _reply_frames(self, frames: List[bytes]) -> List:
+        """Decode + dispatch one multipart message, returning the reply
+        FRAMES.  NEVER raises: a truncated or garbage message from a
+        broken peer — a corrupted metadata frame, a tensor frame whose
+        length disagrees with its manifest, or a request that decodes but
         trips _handle — is refused with an error reply and counted,
-        instead of raising out of the REP loop and killing the master."""
+        instead of raising out of the REP loop and killing the master.
+        Legacy (v2-framed) requests — and undecodable ones, whose peer
+        format is unknown — are answered in legacy single-pickle framing
+        so even an out-of-date slave can read its refusal."""
         import logging
 
+        from znicz_tpu.parallel import wire
+
         try:
-            req = pickle.loads(raw)
+            req, info = wire.decode_message(frames)
             if not isinstance(req, dict):
-                raise TypeError(
+                raise wire.WireError(
                     f"decodes to {type(req).__name__}, not a request dict")
         except Exception as exc:
             self.bad_frames += 1
             logging.getLogger("znicz").warning(
-                "refused undecodable frame (%d bytes): %s — bad_frames=%d",
-                len(raw), exc, self.bad_frames)
-            return {"ok": False, "bad_frame": True,
-                    "error": f"bad frame: {exc}"}
+                "refused undecodable message (%d frames, %d bytes): %s "
+                "— bad_frames=%d", len(frames),
+                sum(len(f) for f in frames), exc, self.bad_frames)
+            return [pickle.dumps({"ok": False, "bad_frame": True,
+                                  "error": f"bad frame: {exc}"})]
+        legacy = bool(info.get("legacy"))
+        self.tensor_bytes_raw_in += info.get("raw_bytes", 0)
+        self.tensor_bytes_wire_in += info.get("wire_bytes", 0)
+        if req.get("cmd") == "update":
+            self.updates_received += 1
+            self.update_bytes_in += sum(len(f) for f in frames)
         try:
-            return self._handle(req)
+            rep = self._handle(req)
         except Exception as exc:
             self.bad_frames += 1
             logging.getLogger("znicz").exception(
                 "refused malformed request %r", req.get("cmd"))
-            return {"ok": False, "bad_frame": True,
-                    "error": f"malformed request: {exc!r}"}
+            rep = {"ok": False, "bad_frame": True,
+                   "error": f"malformed request: {exc!r}"}
+        if legacy:
+            return [pickle.dumps(rep)]
+        rep_frames, enc = wire.encode_message(
+            rep, compress=None if self.wire_compress in ("", "none")
+            else self.wire_compress)
+        self.tensor_bytes_raw_out += enc["raw_bytes"]
+        self.tensor_bytes_wire_out += enc["wire_bytes"]
+        return rep_frames
 
     def _handle(self, req: dict) -> dict:
         cmd = req.get("cmd")
@@ -576,6 +661,10 @@ class Server:
             self._job_seq += 1
             jid = self._job_seq
             self._inflight[jid] = (job, time.time(), sid)
+            if req.get("prefetch"):
+                # the client's pipeline socket asked for this job ahead
+                # of need — the fetch overlapped compute (ISSUE 3)
+                self.prefetch_hit += 1
             return {"job_id": jid, "job": job,
                     "params": self.snapshot_params(),
                     "train": job["class"] == TRAIN}

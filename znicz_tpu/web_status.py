@@ -79,11 +79,26 @@ class WebStatus:
             # structures from this HTTP thread could raise mid-request
             live = dict(srv.slaves)
             dead = dict(srv.dead_slaves)
+            from znicz_tpu.network_common import PROTOCOL_VERSION
+
+            ratio = srv.compression_ratio()
+            bpu = srv.bytes_per_update()
             out["master"] = {
                 "endpoint": srv.endpoint,
+                "protocol_version": PROTOCOL_VERSION,
                 "jobs_done": srv.jobs_done,
                 "jobs_requeued": srv.jobs_requeued,
                 "stale_updates": srv.stale_updates,
+                # wire-v3 traffic counters (ISSUE 3):
+                "bytes_in": srv.bytes_in,
+                "bytes_out": srv.bytes_out,
+                "updates_received": srv.updates_received,
+                "update_bytes_in": srv.update_bytes_in,
+                "bytes_per_update": None if bpu is None else round(bpu, 1),
+                "compression_ratio": None if ratio is None
+                else round(ratio, 3),
+                "prefetch_hit": srv.prefetch_hit,
+                "wire_compress": srv.wire_compress,
                 # robustness counters (fault model, README):
                 "bad_updates": srv.bad_updates,
                 "bad_frames": srv.bad_frames,
@@ -148,6 +163,13 @@ class WebStatus:
                             f"{master['job_timeout_s']}s"
                             f"{', RESUMED' if master['resumed'] else ''}"
                             "</p>"
+                            f"<p>wire v{master['protocol_version']}: "
+                            f"{master['bytes_in']} B in / "
+                            f"{master['bytes_out']} B out, "
+                            f"bytes/update: {master['bytes_per_update']}, "
+                            "compression ratio: "
+                            f"{master['compression_ratio']}, prefetch "
+                            f"hits: {master['prefetch_hit']}</p>"
                             "<table border=1><tr><th>slave</th><th>jobs"
                             f"</th><th>last seen</th></tr>{srows}</table>"
                             f"<p>dead slaves: {len(master['dead_slaves'])}"
