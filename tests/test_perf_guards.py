@@ -164,7 +164,18 @@ def test_async_snapshot_does_not_stall_training_cpu():
     per-epoch writeback+pickle costs many multiples — the r4 product
     bench measured ~10x).  On the tunneled TPU host the same pull is
     ~60 s of shared-link occupancy; BASELINE.md carries that measured
-    analysis — physics, not machinery."""
+    analysis — physics, not machinery.
+
+    DE-FLAKE (ISSUE 4 satellite; VERDICT r5: passed standalone, flaked
+    in-suite under load): the baseline is measured IN-RUN and
+    INTERLEAVED — gated/active runs alternate, so a container load
+    spike (this box's cgroup CPU share swings minute to minute) hits
+    both variants instead of only the block that happened to run
+    during it, and the best-of maxima converge fairly.  Rounds are
+    bounded: the assertion is checked after each gated+active pair and
+    the test passes as soon as the band holds, up to MAX_ROUNDS pairs
+    — a real regression (the active best suppressed by multiples)
+    still fails every round."""
     from znicz_tpu.core.mutable import Bool
     from znicz_tpu.parallel.fused import FusedTrainer
     from znicz_tpu.samples import mnist
@@ -195,12 +206,21 @@ def test_async_snapshot_does_not_stall_training_cpu():
             assert wf.snapshotter.async_saves_written > 0
         return trainer.stats["warm_img_per_sec"]
 
-    run_once(True)                    # compile warm
-    # best-of-3: load spikes only slow runs down (see the confusion
-    # guard's rationale); a writer that stalls the loop suppresses every
-    # run, including the best one
-    gated = max(run_once(False) for _ in range(3))
-    active = max(run_once(True) for _ in range(3))
+    run_once(True)                    # compile warm (both variants'
+    run_once(False)                   # dispatch kinds)
+    # interleaved best-of pairs: load spikes only slow runs down (see
+    # the confusion guard's rationale), and alternating the variants
+    # keeps a spike from suppressing ONE side's whole block — the exact
+    # in-suite flake mode of the old gated*3-then-active*3 ordering.
+    # A writer that really stalls the loop suppresses every active run,
+    # including the best of MAX_ROUNDS.
+    MAX_ROUNDS = 4
+    gated = active = 0.0
+    for _ in range(MAX_ROUNDS):
+        gated = max(gated, run_once(False))
+        active = max(active, run_once(True))
+        if active >= 0.5 * gated:
+            break
     assert active >= 0.5 * gated, (active, gated)
 
 

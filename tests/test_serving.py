@@ -1,0 +1,466 @@
+"""Dynamic-batching inference serving layer (ISSUE 4): batcher policy
+units, 0-ULP batched-vs-unbatched parity, bucket-ladder jit-cache
+hygiene, the wire Codec extraction, snapshot inference-load, the
+ChaosProxy soak, the web panel, and the --serve CLI."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+
+
+def _tiny_mnist_wf(n_train=120, layers=None):
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = n_train
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    if layers is not None:
+        root.mnist.layers = list(layers)
+    try:
+        wf = mnist.MnistWorkflow()
+    finally:
+        root.mnist.layers = [100, 10]
+    wf.initialize(device=None)
+    return wf
+
+
+# -- batcher policy -----------------------------------------------------------
+
+
+def test_bucket_ladder():
+    from znicz_tpu.serving import BucketLadder
+
+    lad = BucketLadder(32)
+    assert lad.rungs == [1, 2, 4, 8, 16, 32]
+    assert lad.bucket_for(1) == 1
+    assert lad.bucket_for(3) == 4
+    assert lad.bucket_for(32) == 32
+    with pytest.raises(ValueError):
+        lad.bucket_for(33)
+    # non-power-of-two max_batch gets its own top rung
+    assert BucketLadder(24).rungs == [1, 2, 4, 8, 16, 24]
+    # explicit rungs must end at max_batch
+    with pytest.raises(ValueError):
+        BucketLadder(8, rungs=[1, 4])
+
+
+def _req(n):
+    from znicz_tpu.serving import Request
+
+    return Request(np.zeros((n, 4), np.float32), n, req_id=n)
+
+
+def test_batcher_coalesces_under_max_batch():
+    from znicz_tpu.serving import DynamicBatcher
+
+    b = DynamicBatcher(max_batch=8, max_delay_ms=50.0, queue_bound=100)
+    for n in (3, 2, 2, 4):              # 3+2+2 fit; 4 would overflow
+        assert b.submit(_req(n)) is None
+    batch = b.next_batch(timeout=0.5)
+    assert [r.n for r in batch] == [3, 2, 2]   # order preserved, 4 left
+    assert b.queue_depth == 4
+    batch2 = b.next_batch(timeout=0.5)
+    assert [r.n for r in batch2] == [4]
+    assert b.bucket_hits[8] == 1 and b.bucket_hits[4] == 1
+    assert b.batched_rows == 11 and b.padded_rows == (8 - 7) + 0
+
+
+def test_batcher_max_delay_flushes_partial():
+    from znicz_tpu.serving import DynamicBatcher
+
+    b = DynamicBatcher(max_batch=32, max_delay_ms=30.0, queue_bound=100)
+    b.submit(_req(2))
+    t0 = time.perf_counter()
+    batch = b.next_batch(timeout=1.0)
+    waited = time.perf_counter() - t0
+    assert [r.n for r in batch] == [2]
+    assert 0.02 <= waited < 0.5          # the window, not the timeout
+    # wait_fill=False takes only what is queued, instantly
+    b.submit(_req(1))
+    t0 = time.perf_counter()
+    batch = b.next_batch(timeout=1.0, wait_fill=False)
+    assert [r.n for r in batch] == [1]
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_batcher_backpressure_sheds_at_bound():
+    from znicz_tpu.serving import DynamicBatcher
+
+    b = DynamicBatcher(max_batch=4, max_delay_ms=1.0, queue_bound=10)
+    for _ in range(5):
+        assert b.submit(_req(2)) is None
+    reason = b.submit(_req(2))           # 12 rows would exceed 10
+    assert reason is not None and "shed" in reason
+    assert b.shed == 1
+    # oversized is refused outright, never queued
+    reason = b.submit(_req(5))
+    assert reason is not None and "max_batch" in reason
+    assert b.oversized == 1
+    assert b.queue_depth == 10
+
+
+# -- codec extraction (ISSUE 4 satellite) -------------------------------------
+
+
+def test_codec_frames_byte_identical_and_counted():
+    from znicz_tpu.parallel import wire
+
+    msg = {"cmd": "infer", "req_id": 7,
+           "x": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    bare, info = wire.encode_message(msg)
+    codec = wire.Codec()
+    framed = codec.encode(msg)
+    assert [bytes(f) for f in framed] == [bytes(f) for f in bare]
+    assert codec.bytes_out == sum(len(bytes(f)) for f in bare)
+    assert codec.tensor_bytes_wire_out == info["wire_bytes"]
+    dec, dinfo = codec.decode([bytes(f) for f in framed])
+    assert np.array_equal(dec["x"], msg["x"])
+    assert codec.bytes_in == codec.bytes_out
+    assert dinfo["message_bytes"] == codec.bytes_in
+    assert codec.compression_ratio("in") == pytest.approx(1.0)
+    # refusal: counted, legacy-framed (single pickle any peer can read)
+    frames = codec.refusal("bad frame: torn")
+    assert codec.bad_frames == 1
+    import pickle
+
+    rep = pickle.loads(frames[0])
+    assert rep["bad_frame"] and "torn" in rep["error"]
+
+
+def test_server_counters_ride_the_codec(tmp_path):
+    """The Server's historical counter names read/write its Codec (the
+    resume snapshot setattr's them by name)."""
+    from znicz_tpu.server import Server
+
+    wf = _tiny_mnist_wf()
+    srv = Server(wf, endpoint="tcp://127.0.0.1:17579")
+    srv.bytes_in = 123
+    assert srv.codec.bytes_in == 123
+    srv.bad_frames += 1
+    assert srv.codec.bad_frames == 1
+    srv.codec.tensor_bytes_raw_in = 40
+    srv.codec.tensor_bytes_wire_in = 10
+    assert srv.compression_ratio("in") == pytest.approx(4.0)
+
+
+# -- model runner: parity + jit-cache hygiene ---------------------------------
+
+
+def test_batched_vs_unbatched_parity_0ulp_and_padding_masked():
+    """The dynamic-batching correctness contract, to 0 ULP: a request's
+    result rows are a pure function of ITS rows and the bucket
+    executable — independent of what it was coalesced with, its offset
+    inside the batch, and the pad content.  (Parity is per BUCKET: XLA
+    compiles a different executable per batch shape, and e.g. the
+    batch-1 gemv path legitimately differs from the gemm path in final
+    bits — which is exactly why the ladder pins the executable set.)"""
+    from znicz_tpu.serving import ModelRunner
+
+    wf = _tiny_mnist_wf()
+    runner = ModelRunner(wf)
+    rng = np.random.default_rng(7)
+    xs = [rng.normal(0, 1, (n, 784)).astype(np.float32)
+          for n in (1, 4, 3)]            # 8 rows: one bucket-8 batch
+    # unbatched reference: each request served ALONE in bucket 8
+    alone = [runner.infer(runner.pad(x, 8))[:len(x)] for x in xs]
+    # coalesced: all three share one bucket-8 batch
+    batched = runner.infer(np.concatenate(xs, axis=0))
+    off = 0
+    for x, ref in zip(xs, alone):
+        assert np.array_equal(batched[off:off + len(x)], ref)
+        off += len(x)
+    # padding is masked out of results AND cannot leak in: garbage pad
+    # rows leave the real rows bit-identical
+    garbage = runner.pad(xs[2], 8)
+    garbage[3:] = 1e9
+    assert np.array_equal(runner.infer(garbage)[:3], alone[2])
+
+
+def test_warmup_compiles_ladder_then_zero_recompiles():
+    from znicz_tpu.serving import BucketLadder, ModelRunner
+
+    wf = _tiny_mnist_wf()
+    runner = ModelRunner(wf)
+    ladder = BucketLadder(8)
+    n = runner.warmup(ladder)
+    assert n == len(ladder.rungs)
+    if runner.jit_cache_size() is not None:
+        assert runner.jit_cache_size() == n
+    for rows in (1, 3, 7, 8, 2, 5, 4, 6):
+        runner.infer(np.zeros((ladder.bucket_for(rows),) + (784,),
+                              np.float32))
+    assert runner.compiles == n          # every bucket was a cache hit
+
+
+# -- snapshot inference-load path ---------------------------------------------
+
+
+def test_snapshot_inference_load(tmp_path):
+    from znicz_tpu import snapshotter
+    from znicz_tpu.serving import ModelRunner
+
+    wf = _tiny_mnist_wf()
+    wf.snapshotter.directory = str(tmp_path)   # before run(): the
+    # on-improvement save must not land in the repo's snapshots/
+    root.mnist.decision.max_epochs = 1
+    try:
+        wf.run()
+    finally:
+        root.mnist.decision.max_epochs = 5
+    path = wf.snapshotter.save("serve_test")
+    trained = {f.name: {k: np.array(a.map_read())
+                        for k, a in f.params().items()}
+               for f in wf.forwards}
+
+    fresh = _tiny_mnist_wf()
+    meta = snapshotter.load_inference(fresh, path)
+    assert "units" not in meta and "epoch" in meta
+    for f in fresh.forwards:
+        for k, a in f.params().items():
+            np.testing.assert_array_equal(np.array(a.map_read()),
+                                          trained[f.name][k])
+    # the served forward IS the trained function
+    runner = ModelRunner(fresh)
+    x = np.asarray(wf.loader.original_data.mem[:5], np.float32)
+    y = runner.infer(x)
+    assert y.shape == (5, 10) and np.all(np.isfinite(y))
+
+    # a snapshot that does not cover the model's weighted layers is
+    # refused, not silently half-served
+    with pytest.raises(ValueError, match="no params"):
+        snapshotter.restore_inference(fresh, {"units": {"fwd0": {}}})
+
+
+# -- end-to-end service -------------------------------------------------------
+
+
+def test_e2e_mixed_sizes_parity_and_stats():
+    from znicz_tpu.serving import (InferenceClient, InferenceError,
+                                   InferenceServer)
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=8, max_delay_ms=3.0,
+                          queue_bound=64).start()
+    cli = InferenceClient(srv.endpoint, timeout=30)
+    try:
+        compiles_warm = srv.runner.compiles
+        ladder = srv.batcher.ladder
+        rng = np.random.default_rng(11)
+        for n in (1, 3, 8, 2, 5, 1, 7, 4):
+            x = rng.normal(0, 1, (n, 784)).astype(np.float32)
+            y = cli.infer(x)
+            # 0 ULP e2e vs the request served directly at its bucket
+            ref = srv.runner.infer(
+                srv.runner.pad(x, ladder.bucket_for(n)))[:n]
+            assert np.array_equal(y, ref)
+        # a bare sample (no batch axis) is accepted
+        y = cli.infer(rng.normal(0, 1, (784,)).astype(np.float32))
+        assert y.shape == (1, 10)
+        assert srv.runner.compiles == compiles_warm   # zero recompiles
+        # oversized requests are refused with the reason, not dropped
+        with pytest.raises(InferenceError, match="max_batch"):
+            cli.infer(np.zeros((9, 784), np.float32))
+        # wrong sample shape is refused readably
+        with pytest.raises(InferenceError, match="sample shape"):
+            cli.infer(np.zeros((2, 77), np.float32))
+        # control commands + the stats payload the web panel shows
+        assert cli.ping()["pong"]
+        stats = cli.stats()
+        assert stats["served"] >= 9 and stats["rejected"] >= 1
+        assert stats["p50_ms"] is not None
+        assert sum(stats["batcher"]["bucket_hits"].values()) \
+            == stats["batcher"]["batches"]
+        assert stats["model"]["compiles"] == compiles_warm
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_start_surfaces_real_bind_error():
+    """start() re-raises the serve thread's actual failure (bind
+    conflict here) instead of hanging out a timeout and masking it."""
+    from znicz_tpu.serving import InferenceServer
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=2, max_delay_ms=1.0).start()
+    try:
+        with pytest.raises(RuntimeError, match="failed on"):
+            InferenceServer(wf, bind=srv.endpoint, max_batch=2,
+                            max_delay_ms=1.0).start()
+    finally:
+        srv.stop()
+
+
+def test_e2e_undecodable_frames_refused_not_fatal():
+    """A garbage request is refused with a counted error reply and the
+    service keeps serving — the master's bad-frame fault model extends
+    to serving."""
+    import zmq
+
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=2.0).start()
+    ctx = zmq.Context.instance()
+    raw = ctx.socket(zmq.DEALER)
+    raw.setsockopt(zmq.RCVTIMEO, 10_000)
+    raw.setsockopt(zmq.LINGER, 0)
+    raw.connect(srv.endpoint)
+    cli = InferenceClient(srv.endpoint, timeout=30)
+    try:
+        from znicz_tpu.parallel import wire
+
+        raw.send_multipart([b"\xff garbage \x00"])
+        rep, _ = wire.decode_message(raw.recv_multipart())
+        assert rep["bad_frame"] is True
+        assert srv.bad_frames == 1
+        # the service still answers real requests afterwards
+        y = cli.infer(np.zeros((2, 784), np.float32))
+        assert y.shape == (2, 10)
+    finally:
+        raw.close(0)
+        cli.close()
+        srv.stop()
+
+
+def test_chaos_soak_serving():
+    """Multi-client soak through the seeded ChaosProxy: dropped and
+    corrupted frames in BOTH directions, duplicated and delayed
+    messages — every request still completes with bit-exact results
+    (resend + req_id dedup), the server never crashes, and every
+    corrupted request-direction message is accounted in ``bad_frames``
+    exactly like the master's fault model."""
+    from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=2.0,
+                          queue_bound=64).start()
+    proxy = ChaosProxy("tcp://127.0.0.1:17591", srv.endpoint,
+                       FaultSchedule(2024, drop=0.05, corrupt=0.06,
+                                     duplicate=0.04, delay=0.05,
+                                     delay_s=(0.01, 0.05))).start()
+    errs = []
+    rng = np.random.default_rng(5)
+    payloads = [rng.normal(0, 1, (1 + i % 4, 784)).astype(np.float32)
+                for i in range(12)]
+    expected = [None] * len(payloads)
+
+    def worker(wid):
+        cli = InferenceClient("tcp://127.0.0.1:17591", timeout=60,
+                              resend_after_s=0.3)
+        try:
+            for i in range(wid, len(payloads), 3):
+                y = cli.infer(payloads[i])
+                expected[i] = y
+        except Exception as exc:        # pragma: no cover - failure path
+            errs.append((wid, exc))
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errs, errs
+        assert all(e is not None for e in expected)
+        # bit-exact through the chaos: concurrent clients coalesce, so
+        # a request may have been served under ANY rung >= its rows —
+        # its bits must match that rung's executable exactly (pure
+        # function of own rows + bucket; zero cross-request leakage)
+        ladder = srv.batcher.ladder
+        for i, x in enumerate(payloads):
+            refs = [srv.runner.infer(srv.runner.pad(x, b))[:len(x)]
+                    for b in ladder.rungs if b >= len(x)]
+            assert any(np.array_equal(expected[i], ref)
+                       for ref in refs), i
+        # accounting: every corrupted request-direction message the
+        # proxy injected was refused and counted by the server
+        assert srv.bad_frames == proxy.counters["req"]["corrupt"]
+        if proxy.counters["req"]["corrupt"]:
+            assert srv.bad_frames > 0
+        assert srv.served >= len(payloads)
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_web_status_serving_panel():
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.web_status import WebStatus
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=2.0).start()
+    status = WebStatus(port=0).start()
+    cli = InferenceClient(srv.endpoint, timeout=30)
+    try:
+        status.register(wf)
+        status.register_inference(srv)
+        cli.infer(np.zeros((2, 784), np.float32))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            snap = json.load(r)
+        panel = snap["serving"]
+        assert panel["served"] >= 1
+        assert panel["endpoint"] == srv.endpoint
+        for key in ("qps", "p50_ms", "p99_ms", "rejected", "timed_out",
+                    "bad_frames"):
+            assert key in panel
+        assert panel["batcher"]["queue_depth"] == 0
+        assert sum(panel["batcher"]["bucket_hits"].values()) >= 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/") as r:
+            page = r.read().decode()
+        assert "Serving" in page and "occupancy" in page
+    finally:
+        cli.close()
+        status.stop()
+        srv.stop()
+
+
+def test_launcher_serve_cli():
+    from znicz_tpu.launcher import main
+    from znicz_tpu.serving import InferenceClient
+
+    # role flags are mutually exclusive
+    assert main(["mnist", "--serve", "--master"]) == 2
+
+    endpoint = "tcp://127.0.0.1:17592"
+    root.common.serving.max_requests = 2
+    rc = {}
+
+    def run_cli():
+        rc["code"] = main([
+            "mnist", "--serve", endpoint,
+            "root.mnist.loader.n_train=120",
+            "root.mnist.loader.n_valid=60",
+            "root.mnist.loader.minibatch_size=60",
+        ])
+
+    t = threading.Thread(target=run_cli)
+    t.start()
+    try:
+        cli = InferenceClient(endpoint, timeout=90, resend_after_s=2.0)
+        try:
+            y = cli.infer(np.zeros((2, 784), np.float32), timeout=90)
+            assert y.shape == (2, 10)
+            cli.infer(np.zeros((1, 784), np.float32), timeout=90)
+        finally:
+            cli.close()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert rc["code"] == 0
+    finally:
+        root.common.serving.max_requests = None
+        t.join(timeout=5)

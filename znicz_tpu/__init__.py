@@ -30,6 +30,11 @@ Layer map (mirrors SURVEY.md §1, rebuilt for TPU):
                               master-slave DP with SPMD psum over ICI.
                               The async master/slave mode survives in
                               ``server``/``client``/``network_common``.
+  - ``znicz_tpu.serving``   — dynamic-batching inference service: frozen
+                              snapshot params behind a ZMQ ROUTER on the
+                              wire-v3 codec, request coalescing with a
+                              bucket-ladder jit cache and donated
+                              ping-pong staging (launcher --serve).
   - ``znicz_tpu.samples``   — MNIST, CIFAR10, MnistAE, Kohonen, AlexNet
                               (BASELINE.json configs 0-4) + Wine,
                               YaleFaces, Kanji, VideoAE.

@@ -82,6 +82,14 @@ class Launcher:
         parser.add_argument("--slave", default=None, metavar="ENDPOINT",
                             help="work for the master at ENDPOINT "
                                  "(e.g. tcp://host:5570)")
+        parser.add_argument("--serve", nargs="?", const="tcp://*:5580",
+                            default=None, metavar="BIND",
+                            help="serve this workflow's forward as a "
+                                 "dynamic-batching inference service "
+                                 "instead of training (load params with "
+                                 "--snapshot; default bind tcp://*:5580; "
+                                 "knobs: root.common.serving.max_batch/"
+                                 "max_delay_ms/queue_bound)")
         parser.add_argument("--master-resume", default="", metavar="FILE",
                             help="master crash-resume file: restore "
                                  "training state from FILE when it "
@@ -123,6 +131,12 @@ class Launcher:
             print("error: --master and --slave are mutually exclusive",
                   file=sys.stderr)
             return 2
+        if args.serve is not None and (args.master is not None
+                                       or args.slave is not None
+                                       or args.master_resume):
+            print("error: --serve is mutually exclusive with the "
+                  "master/slave training roles", file=sys.stderr)
+            return 2
         if args.master_resume:
             if args.slave is not None:
                 print("error: --master-resume applies to the master role",
@@ -149,6 +163,8 @@ class Launcher:
         if spec in SAMPLES:
             spec = f"znicz_tpu.samples.{spec}"
         mod = _load_module(spec, "znicz_tpu._user_workflow")
+        if args.serve is not None:
+            return self._serve(mod, spec, args)
         if not hasattr(mod, "run"):
             print(f"error: {spec} does not expose run()", file=sys.stderr)
             return 2
@@ -189,6 +205,56 @@ class Launcher:
                       file=sys.stderr)
                 return 3
             print(json.dumps({"genetics_fitness": float(fit)}), flush=True)
+        return 0
+
+    def _serve(self, mod, spec: str, args) -> int:
+        """``--serve``: build the module's workflow WITHOUT training it
+        (the samples' ``run()`` trains), load ``--snapshot`` through the
+        snapshotter's inference-load path, and serve the frozen forward
+        as a dynamic-batching service until interrupted (or until
+        ``root.common.serving.max_requests`` requests, for tests)."""
+        from znicz_tpu.core.workflow import Workflow
+
+        classes = [v for v in vars(mod).values()
+                   if isinstance(v, type) and issubclass(v, Workflow)
+                   and v is not Workflow
+                   and v.__module__ == mod.__name__]
+        if len(classes) != 1:
+            print(f"error: --serve needs exactly one Workflow subclass "
+                  f"in {spec}; found "
+                  f"{[c.__name__ for c in classes] or 'none'}",
+                  file=sys.stderr)
+            return 2
+        wf = classes[0]()
+        wf.initialize(device=None)
+
+        from znicz_tpu.serving import InferenceServer
+
+        max_requests = root.common.serving.get("max_requests", None)
+        server = InferenceServer(
+            wf, bind=args.serve, snapshot=args.snapshot,
+            max_requests=None if max_requests is None
+            else int(max_requests))
+        status = None
+        web_port = root.common.serving.get("web_port", None)
+        if web_port is not None:
+            from znicz_tpu.web_status import WebStatus
+
+            status = WebStatus(port=int(web_port)).start()
+            status.register(wf)
+            status.register_inference(server)
+            print(f"status dashboard -> http://127.0.0.1:{status.port}/")
+        server.start()
+        print(f"serving {wf.name} at {server.endpoint} "
+              f"(snapshot: {args.snapshot or 'fresh init'})", flush=True)
+        try:
+            server.join()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
+            if status is not None:
+                status.stop()
         return 0
 
 

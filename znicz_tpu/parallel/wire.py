@@ -372,6 +372,100 @@ def decode_message(frames: List[bytes]) -> Tuple[Any, Dict[str, Any]]:
     return _unwalk(skeleton), info
 
 
+class Codec:
+    """Stateful message codec: the v3 encode/decode pair PLUS the byte and
+    tensor accounting every peer keeps, with no Server/Client instance
+    required (ISSUE 4 satellite).  The master's REP loop and the serving
+    frontend share this one home, so the counters — and the frames, which
+    are byte-identical to calling :func:`encode_message` /
+    :func:`decode_message` directly — cannot drift between services.
+
+    Counters: ``bytes_in``/``bytes_out`` (every frame of every message,
+    refusals included), ``tensor_bytes_raw_*``/``tensor_bytes_wire_*``
+    (f32-equivalent vs actual tensor bytes per direction — the
+    compression-ratio inputs), ``bad_frames`` (undecodable messages
+    refused via :meth:`refusal`, plus whatever the owner adds for
+    requests that decode but trip its handler).
+
+    Threading: counters are plain ints — confine each instance to one
+    thread (the serving frontend does all socket+codec work on its
+    router thread; the master's REP loop is single-threaded already).
+    """
+
+    def __init__(self, compress: Optional[str] = None):
+        #: cold-path per-tensor compression applied by :meth:`encode`
+        #: ("none"/""/None = off) — the params-broadcast knob
+        self.compress = None if compress in (None, "", "none") else compress
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.messages_in = 0
+        self.messages_out = 0
+        self.bad_frames = 0
+        self.tensor_bytes_raw_in = 0
+        self.tensor_bytes_wire_in = 0
+        self.tensor_bytes_raw_out = 0
+        self.tensor_bytes_wire_out = 0
+
+    @staticmethod
+    def frames_bytes(frames: List) -> int:
+        return sum(f.nbytes if isinstance(f, memoryview) else len(f)
+                   for f in frames)
+
+    def decode(self, frames: List[bytes]) -> Tuple[Any, Dict[str, Any]]:
+        """:func:`decode_message` plus inbound accounting.  The info dict
+        gains ``message_bytes`` (total wire bytes of the message — what
+        per-message metrics like ``bytes_per_update`` want).  Raises
+        :class:`WireError` exactly like the bare function; the caller
+        decides whether that refusal ticks :attr:`bad_frames` (via
+        :meth:`refusal`) or is fatal."""
+        n = self.frames_bytes(frames)
+        self.bytes_in += n
+        msg, info = decode_message(frames)
+        info["message_bytes"] = n
+        self.messages_in += 1
+        self.tensor_bytes_raw_in += info.get("raw_bytes", 0)
+        self.tensor_bytes_wire_in += info.get("wire_bytes", 0)
+        return msg, info
+
+    def encode(self, msg: Any, legacy: bool = False) -> List[Any]:
+        """Message -> reply frames plus outbound accounting.  ``legacy``
+        answers a v2-framed peer in kind: one pickled frame (no tensor
+        accounting — the blob is opaque), so even an out-of-date peer
+        can read its reply."""
+        if legacy:
+            frames = [pickle.dumps(msg)]
+        else:
+            frames, enc = encode_message(msg, compress=self.compress)
+            self.tensor_bytes_raw_out += enc["raw_bytes"]
+            self.tensor_bytes_wire_out += enc["wire_bytes"]
+        self.bytes_out += self.frames_bytes(frames)
+        self.messages_out += 1
+        return frames
+
+    def refusal(self, error: str, legacy: bool = True, **extra) -> List:
+        """The counted bad-frame refusal reply: ``bad_frames`` ticks and
+        the reply defaults to LEGACY framing — an undecodable request's
+        peer format is unknown, and a single pickle is the one framing
+        every protocol revision can read."""
+        self.bad_frames += 1
+        return self.encode({"ok": False, "bad_frame": True,
+                            "error": error, **extra}, legacy=legacy)
+
+    def compression_ratio(self, direction: str = "both"
+                          ) -> Optional[float]:
+        """f32-equivalent tensor bytes / tensor bytes actually on the
+        wire — ``"in"``, ``"out"`` or ``"both"``; None before any tensor
+        traffic in that direction."""
+        raw = ((self.tensor_bytes_raw_in if direction != "out" else 0)
+               + (self.tensor_bytes_raw_out if direction != "in" else 0))
+        cooked = ((self.tensor_bytes_wire_in if direction != "out" else 0)
+                  + (self.tensor_bytes_wire_out if direction != "in"
+                     else 0))
+        if not cooked:
+            return None
+        return raw / cooked
+
+
 def split_envelope(frames: List[bytes]
                    ) -> Tuple[List[bytes], List[bytes]]:
     """ROUTER-side framing helper: (routing envelope incl. the empty

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import collections
 import os
-import pickle
 import time
 from typing import Dict, List, Optional
 
@@ -37,6 +36,19 @@ import numpy as np
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
+
+
+def _codec_counter(name: str, doc: str) -> property:
+    """A Server attribute that lives on its wire.Codec — readable AND
+    writable under the historical name (restore_resume setattr's them)."""
+
+    def fget(self):
+        return getattr(self.codec, name)
+
+    def fset(self, value):
+        setattr(self.codec, name, value)
+
+    return property(fget, fset, doc=doc)
 
 
 class Server:
@@ -86,27 +98,24 @@ class Server:
         self.jobs_requeued = 0
         self.stale_updates = 0
         self.bad_updates = 0            # malformed replies refused+requeued
-        self.bad_frames = 0             # undecodable/garbage frames refused
         self.quarantined_updates = 0    # non-finite / norm-exploded deltas
         self.reregistrations = 0        # re-registers (slave reconnects)
         self.resume_saves = 0           # crash-resume snapshots written
-        # -- wire-v3 traffic accounting (ISSUE 3) --------------------------
-        self.bytes_in = 0               # wire bytes received (all frames)
-        self.bytes_out = 0              # wire bytes sent (all frames)
-        self.updates_received = 0       # update messages seen (any outcome)
-        self.update_bytes_in = 0        # wire bytes of those updates
-        self.prefetch_hit = 0           # jobs served to prefetch requests
-        # f32-equivalent vs actual tensor bytes, per direction: ``in`` is
-        # dominated by (possibly quantized) deltas, ``out`` by the
-        # (possibly compressed) params broadcast
-        self.tensor_bytes_raw_in = 0
-        self.tensor_bytes_wire_in = 0
-        self.tensor_bytes_raw_out = 0
-        self.tensor_bytes_wire_out = 0
         #: cold-path compression of the params broadcast ("none"/"zlib"/
         #: "lz4"); deltas are quantized by the CLIENT (engine.wire_dtype)
         self.wire_compress = str(
             root.common.engine.get("wire_compress", "none"))
+        # -- wire-v3 traffic accounting (ISSUE 3 / ISSUE 4): one shared
+        # Codec holds bytes_in/out, the per-direction tensor byte pairs
+        # and bad_frames; the class-level properties below keep the
+        # counters readable/writable under their historical names
+        # (web_status, resume snapshots, tests)
+        from znicz_tpu.parallel import wire
+
+        self.codec = wire.Codec(compress=self.wire_compress)
+        self.updates_received = 0       # update messages seen (any outcome)
+        self.update_bytes_in = 0        # wire bytes of those updates
+        self.prefetch_hit = 0           # jobs served to prefetch requests
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
@@ -162,6 +171,26 @@ class Server:
                     mem = arr.map_write()
                     mem += d[k]
 
+    # -- wire accounting (one home: the Codec) ---------------------------------
+
+    bytes_in = _codec_counter(
+        "bytes_in", "wire bytes received (all frames)")
+    bytes_out = _codec_counter(
+        "bytes_out", "wire bytes sent (all frames)")
+    bad_frames = _codec_counter(
+        "bad_frames", "undecodable/garbage frames refused")
+    #: f32-equivalent vs actual tensor bytes, per direction: ``in`` is
+    #: dominated by (possibly quantized) deltas, ``out`` by the
+    #: (possibly compressed) params broadcast
+    tensor_bytes_raw_in = _codec_counter(
+        "tensor_bytes_raw_in", "f32-equivalent tensor bytes received")
+    tensor_bytes_wire_in = _codec_counter(
+        "tensor_bytes_wire_in", "actual tensor bytes received")
+    tensor_bytes_raw_out = _codec_counter(
+        "tensor_bytes_raw_out", "f32-equivalent tensor bytes sent")
+    tensor_bytes_wire_out = _codec_counter(
+        "tensor_bytes_wire_out", "actual tensor bytes sent")
+
     # -- job management --------------------------------------------------------
 
     def compression_ratio(self, direction: str = "both"
@@ -170,14 +199,7 @@ class Server:
         wire — ``"in"`` (quantized deltas), ``"out"`` (optionally
         compressed params broadcast) or ``"both"``; None before any
         tensor traffic in that direction."""
-        raw = ((self.tensor_bytes_raw_in if direction != "out" else 0)
-               + (self.tensor_bytes_raw_out if direction != "in" else 0))
-        cooked = ((self.tensor_bytes_wire_in if direction != "out" else 0)
-                  + (self.tensor_bytes_wire_out if direction != "in"
-                     else 0))
-        if not cooked:
-            return None
-        return raw / cooked
+        return self.codec.compression_ratio(direction)
 
     def bytes_per_update(self) -> Optional[float]:
         """Mean wire bytes of one slave->master update message — the
@@ -547,11 +569,7 @@ class Server:
                 self._maybe_save_resume()
                 if poller.poll(100):
                     frames = self._socket.recv_multipart()
-                    self.bytes_in += sum(len(f) for f in frames)
                     rep_frames = self._reply_frames(frames)
-                    self.bytes_out += sum(
-                        f.nbytes if isinstance(f, memoryview) else len(f)
-                        for f in rep_frames)
                     # copy=False: reply tensor frames are memoryviews of
                     # snapshot_params' fresh copies, never mutated later
                     self._socket.send_multipart(rep_frames, copy=False)
@@ -581,24 +599,21 @@ class Server:
         from znicz_tpu.parallel import wire
 
         try:
-            req, info = wire.decode_message(frames)
+            req, info = self.codec.decode(frames)
             if not isinstance(req, dict):
                 raise wire.WireError(
                     f"decodes to {type(req).__name__}, not a request dict")
         except Exception as exc:
-            self.bad_frames += 1
+            rep_frames = self.codec.refusal(f"bad frame: {exc}")
             logging.getLogger("znicz").warning(
                 "refused undecodable message (%d frames, %d bytes): %s "
                 "— bad_frames=%d", len(frames),
                 sum(len(f) for f in frames), exc, self.bad_frames)
-            return [pickle.dumps({"ok": False, "bad_frame": True,
-                                  "error": f"bad frame: {exc}"})]
+            return rep_frames
         legacy = bool(info.get("legacy"))
-        self.tensor_bytes_raw_in += info.get("raw_bytes", 0)
-        self.tensor_bytes_wire_in += info.get("wire_bytes", 0)
         if req.get("cmd") == "update":
             self.updates_received += 1
-            self.update_bytes_in += sum(len(f) for f in frames)
+            self.update_bytes_in += info["message_bytes"]
         try:
             rep = self._handle(req)
         except Exception as exc:
@@ -607,14 +622,7 @@ class Server:
                 "refused malformed request %r", req.get("cmd"))
             rep = {"ok": False, "bad_frame": True,
                    "error": f"malformed request: {exc!r}"}
-        if legacy:
-            return [pickle.dumps(rep)]
-        rep_frames, enc = wire.encode_message(
-            rep, compress=None if self.wire_compress in ("", "none")
-            else self.wire_compress)
-        self.tensor_bytes_raw_out += enc["raw_bytes"]
-        self.tensor_bytes_wire_out += enc["wire_bytes"]
-        return rep_frames
+        return self.codec.encode(rep, legacy=legacy)
 
     def _handle(self, req: dict) -> dict:
         cmd = req.get("cmd")

@@ -1,0 +1,31 @@
+"""Dynamic-batching inference serving layer (ISSUE 4).
+
+The training side of this repo got three PRs of optimization; this
+package opens the INFERENCE workload the ROADMAP north star ("serves
+heavy traffic from millions of users") requires: load a snapshot,
+freeze params into an inference-only jitted forward, and serve
+concurrent clients over ZeroMQ with the same wire-v3 zero-copy tensor
+codec the master/slave stack speaks.
+
+    serving/batcher.py   BucketLadder + DynamicBatcher — request
+                         coalescing under (max_batch, max_delay_ms),
+                         padding to a fixed bucket ladder (bounded jit
+                         cache), bounded-queue backpressure
+    serving/model.py     ModelRunner — frozen params, bucketed jit
+                         cache with compile counters, donated
+                         ping-pong stage/infer halves
+    serving/frontend.py  InferenceServer — ZMQ ROUTER + codec + the
+                         overlap compute loop; stats for web_status
+    serving/client.py    InferenceClient — DEALER peer, pipelined
+                         submits, resend-on-loss, req_id dedup
+
+Config home: ``root.common.serving.{max_batch, max_delay_ms,
+queue_bound, request_ttl_s}``; CLI: ``python -m znicz_tpu <workflow>
+--serve [BIND] --snapshot FILE``; bench gate: ``python bench.py
+--serve`` (see README "Serving").
+"""
+
+from .batcher import BucketLadder, DynamicBatcher, Request  # noqa: F401
+from .client import InferenceClient, InferenceError         # noqa: F401
+from .frontend import InferenceServer                       # noqa: F401
+from .model import ModelRunner                              # noqa: F401

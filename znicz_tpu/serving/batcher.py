@@ -1,0 +1,229 @@
+"""Dynamic request batcher for the inference service (ISSUE 4).
+
+Small-request serving throughput is dominated by two costs: the per-
+dispatch overhead of running the model (a batch-1 forward pays the same
+dispatch/jit-call price as a batch-32 one) and jit-cache hygiene (every
+distinct batch shape is a fresh XLA compile).  The batcher attacks both:
+
+  - **Coalescing** (clipper/triton-style): a bounded queue of requests is
+    drained into batches under a ``(max_batch, max_delay_ms)`` policy —
+    a batch closes as soon as it holds ``max_batch`` rows, or when
+    ``max_delay_ms`` has elapsed since its first row was taken (latency
+    is bounded by construction; an idle service adds no delay because
+    the window only starts once a request exists).
+  - **Bucket ladder**: each closed batch is padded up to the next rung
+    of a fixed ladder (powers of two up to ``max_batch`` by default), so
+    the jit cache holds AT MOST ``len(ladder)`` executables and a mixed-
+    size request stream causes ZERO recompiles after warmup
+    (``ModelRunner.compiles`` is the proof counter).
+  - **Backpressure**: the queue is bounded in ROWS; a submit that would
+    exceed ``queue_bound`` is shed immediately (counted, refused with a
+    readable reason) instead of growing an unbounded backlog whose every
+    entry would time out anyway.
+
+Threading contract: ``submit`` may be called from the frontend's router
+thread; ``next_batch`` from the single compute thread.  All state is
+guarded by one condition variable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class BucketLadder:
+    """The fixed ladder of padded batch sizes.  Default rungs are the
+    powers of two up to ``max_batch`` (plus ``max_batch`` itself when it
+    is not a power of two) — a ladder that over-pads by at most 2x while
+    keeping the executable count logarithmic in ``max_batch``."""
+
+    def __init__(self, max_batch: int, rungs: Optional[Sequence[int]] = None):
+        self.max_batch = int(max_batch)
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if rungs is None:
+            rungs = []
+            r = 1
+            while r < self.max_batch:
+                rungs.append(r)
+                r *= 2
+            rungs.append(self.max_batch)
+        rungs = sorted(set(int(r) for r in rungs))
+        if not rungs or rungs[0] < 1 or rungs[-1] != self.max_batch:
+            raise ValueError(
+                f"bucket ladder {rungs} must be positive and end at "
+                f"max_batch={self.max_batch}")
+        self.rungs: List[int] = rungs
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung >= n (n must be within the ladder)."""
+        for r in self.rungs:
+            if n <= r:
+                return r
+        raise ValueError(f"{n} rows exceed the ladder's top rung "
+                         f"{self.rungs[-1]}")
+
+    def __iter__(self):
+        return iter(self.rungs)
+
+    def __repr__(self):
+        return f"BucketLadder({self.rungs})"
+
+
+class Request:
+    """One queued inference request: ``x`` is the (n_rows, *sample) host
+    array, ``reply_to`` an opaque routing token the frontend uses to
+    answer (the ROUTER envelope), ``req_id`` the client's correlation
+    id.  ``t_enqueued`` feeds the latency stats and the TTL check."""
+
+    __slots__ = ("x", "n", "reply_to", "req_id", "t_enqueued")
+
+    def __init__(self, x, n: int, reply_to=None, req_id=None):
+        self.x = x
+        self.n = int(n)
+        self.reply_to = reply_to
+        self.req_id = req_id
+        self.t_enqueued = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Bounded request queue + the coalescing policy (module docstring).
+
+    ``submit`` returns None on acceptance or a human-readable refusal
+    reason (shed/oversized) — the frontend ships the reason back so a
+    client sees WHY it was refused instead of timing out.
+    """
+
+    def __init__(self, max_batch: int = 32, max_delay_ms: float = 5.0,
+                 queue_bound: int = 256,
+                 ladder: Optional[BucketLadder] = None):
+        self.ladder = ladder or BucketLadder(max_batch)
+        self.max_batch = self.ladder.max_batch
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.queue_bound = int(queue_bound)
+        self._q: collections.deque = collections.deque()
+        self._rows = 0                      # rows currently queued
+        self._cond = threading.Condition()
+        self._closed = False
+        # -- accounting (the serving panel's inputs) -----------------------
+        self.submitted = 0                  # accepted requests
+        self.shed = 0                       # refused: queue at bound
+        self.oversized = 0                  # refused: n > max_batch
+        self.batches = 0                    # batches closed
+        self.batched_requests = 0           # requests inside those batches
+        self.batched_rows = 0               # real rows inside those batches
+        self.padded_rows = 0                # pad rows added by the ladder
+        self.bucket_hits: Dict[int, int] = {r: 0 for r in self.ladder}
+
+    # -- producer side ---------------------------------------------------------
+
+    def submit(self, req: Request) -> Optional[str]:
+        if req.n < 1 or req.n > self.max_batch:
+            self.oversized += 1
+            return (f"request of {req.n} rows exceeds max_batch="
+                    f"{self.max_batch} (split it client-side)")
+        with self._cond:
+            if self._closed:
+                return "service is shutting down"
+            if self._rows + req.n > self.queue_bound:
+                self.shed += 1
+                return (f"queue at bound ({self._rows} rows queued, "
+                        f"bound {self.queue_bound}) — shed")
+            self._q.append(req)
+            self._rows += req.n
+            self.submitted += 1
+            self._cond.notify()
+            return None
+
+    @property
+    def queue_depth(self) -> int:
+        """Rows currently queued (not yet taken into a batch)."""
+        return self._rows
+
+    def close(self) -> None:
+        """Wake every waiter; ``next_batch`` drains what is queued and
+        then returns None forever."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- consumer side ---------------------------------------------------------
+
+    def next_batch(self, timeout: float = 0.2,
+                   wait_fill: bool = True) -> Optional[List[Request]]:
+        """The next coalesced batch, or None when nothing arrived within
+        ``timeout``.  Blocks up to ``timeout`` for the FIRST request;
+        from that moment the ``max_delay_ms`` window runs, during which
+        further requests are folded in until ``max_batch`` rows are
+        reached.  A request that does not fit the remaining space stays
+        queued for the next batch (requests are never split).
+
+        ``wait_fill=False`` skips the window: only already-queued
+        requests are taken.  That is the PIPELINED grab — the compute
+        loop calls it while the previous batch is still on the device,
+        and waiting out a window there would hold the finished batch's
+        replies hostage to the next batch's coalescing (measured +1
+        ``max_delay`` on p99)."""
+        with self._cond:
+            deadline = time.perf_counter() + max(timeout, 0.0)
+            while not self._q:
+                if self._closed:
+                    return None
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            batch = [self._q.popleft()]
+            rows = batch[0].n
+            self._rows -= rows
+            flush_at = time.perf_counter() + self.max_delay_s
+            while rows < self.max_batch:
+                if self._q:
+                    if self._q[0].n > self.max_batch - rows:
+                        break               # would overflow: next batch
+                    req = self._q.popleft()
+                    self._rows -= req.n
+                    batch.append(req)
+                    rows += req.n
+                    continue
+                remaining = flush_at - time.perf_counter()
+                if not wait_fill or remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+        bucket = self.ladder.bucket_for(rows)
+        self.batches += 1
+        self.batched_requests += len(batch)
+        self.batched_rows += rows
+        self.padded_rows += bucket - rows
+        self.bucket_hits[bucket] += 1
+        return batch
+
+    # -- stats -----------------------------------------------------------------
+
+    def occupancy(self) -> Optional[float]:
+        """Mean real rows per closed batch / max_batch (None before the
+        first batch) — 1.0 means every batch left full."""
+        if not self.batches:
+            return None
+        return self.batched_rows / (self.batches * self.max_batch)
+
+    def stats(self) -> Dict:
+        occ = self.occupancy()
+        return {
+            "max_batch": self.max_batch,
+            "max_delay_ms": self.max_delay_s * 1e3,
+            "queue_bound": self.queue_bound,
+            "queue_depth": self.queue_depth,
+            "submitted": self.submitted,
+            "shed": self.shed,
+            "oversized": self.oversized,
+            "batches": self.batches,
+            "batched_requests": self.batched_requests,
+            "batched_rows": self.batched_rows,
+            "padded_rows": self.padded_rows,
+            "mean_occupancy": None if occ is None else round(occ, 4),
+            "bucket_hits": dict(self.bucket_hits),
+        }

@@ -1,0 +1,182 @@
+"""Inference client (ISSUE 4): a DEALER peer of the serving frontend.
+
+DEALER (not REQ) on purpose: many requests may be in flight at once
+(the pipelined load that makes dynamic batching pay), replies arrive in
+completion order, and — unlike REQ — a DEALER socket has no lockstep
+EFSM to wedge, so a dropped frame needs no reconnect dance: the client
+just re-sends the SAME already-encoded frames after ``resend_after_s``
+(inference is pure, so a duplicate compute is wasted work, not a
+correctness problem; duplicate replies are deduplicated by ``req_id``).
+
+Messages ride the wire-v3 codec (parallel/wire.py): the request tensor
+and the result tensor are zero-copy buffer frames.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class InferenceError(RuntimeError):
+    """The service answered, but with a refusal (bad frame / shed /
+    timed out / shape mismatch); the reply dict is ``.reply``."""
+
+    def __init__(self, reply: dict):
+        super().__init__(str(reply.get("error") or reply))
+        self.reply = reply
+
+
+class InferenceClient:
+    """One-thread client.  ``infer(x)`` is the synchronous call;
+    ``submit(x)``/``result(req_id)`` expose the pipelined form (keep W
+    requests in flight, collect in any order) the bench's offered-load
+    driver uses.  NOT thread-safe — one instance per thread."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0,
+                 resend_after_s: float = 1.0, max_resends: int = 8):
+        import zmq
+
+        self.endpoint = endpoint
+        self.timeout = float(timeout)
+        self.resend_after_s = float(resend_after_s)
+        self.max_resends = int(max_resends)
+        self.resends = 0                # re-sent requests (lost/ignored)
+        self.bad_replies = 0            # undecodable reply stacks
+        self.errors = 0                 # service refusals received
+        self._ids = itertools.count(1)
+        #: req_id -> [frames, t_last_sent, resends]
+        self._pending: Dict[int, List] = {}
+        self._results: Dict[int, dict] = {}
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(endpoint)
+
+    # -- pipelined API ---------------------------------------------------------
+
+    def _send(self, msg: dict) -> int:
+        """Encode + send one request; returns its req_id.  The payload
+        rides behind a REQ-style EMPTY DELIMITER frame: the server (and
+        any chaos proxy between) splits the envelope at the delimiter,
+        so even a request whose METADATA frame is corrupted in flight
+        keeps a routable envelope — the refusal reply still finds its
+        way back instead of being silently unroutable."""
+        from znicz_tpu.parallel import wire
+
+        rid = next(self._ids)
+        msg["req_id"] = rid
+        payload, _ = wire.encode_message(msg)
+        frames = [b""] + payload
+        self._sock.send_multipart(frames, copy=False)
+        self._pending[rid] = [frames, time.perf_counter(), 0]
+        return rid
+
+    def submit(self, x: np.ndarray) -> int:
+        """Send one inference request; returns its ``req_id``."""
+        return self._send({"cmd": "infer", "x": np.ascontiguousarray(x)})
+
+    def _command(self, cmd: str, timeout: Optional[float] = None) -> dict:
+        return self.result(self._send({"cmd": cmd}), timeout=timeout)
+
+    def ping(self, timeout: Optional[float] = None) -> dict:
+        return self._command("ping", timeout)
+
+    def stats(self, timeout: Optional[float] = None) -> dict:
+        """The server's live stats() dict (the serving panel payload)."""
+        return self._command("stats", timeout)["stats"]
+
+    def _pump(self, wait_s: float) -> None:
+        """Receive every reply available (waiting up to ``wait_s`` for
+        the first) and file each under its req_id; undecodable stacks
+        are counted and dropped (the resend timer recovers the
+        request)."""
+        import zmq
+
+        from znicz_tpu.parallel import wire
+
+        if not self._sock.poll(max(0, int(wait_s * 1000))):
+            return
+        while True:
+            try:
+                raw = self._sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            try:
+                # strip the delimiter the request's envelope carried
+                _, payload = wire.split_envelope(raw)
+                rep, _ = wire.decode_message(payload or raw)
+                if not isinstance(rep, dict):
+                    raise wire.WireError(
+                        f"reply decodes to {type(rep).__name__}")
+            except Exception:
+                self.bad_replies += 1
+                continue
+            rid = rep.get("req_id")
+            if rid in self._pending:
+                del self._pending[rid]
+                self._results[rid] = rep
+            # else: duplicate (our resend raced the original) — dropped
+
+    def _maybe_resend(self) -> None:
+        now = time.perf_counter()
+        for rid, entry in self._pending.items():
+            frames, t_sent, n = entry
+            if now - t_sent < self.resend_after_s:
+                continue
+            if n >= self.max_resends:
+                raise TimeoutError(
+                    f"req {rid}: no reply after {n} resends over "
+                    f"{now - t_sent + n * self.resend_after_s:.1f}s — "
+                    f"service at {self.endpoint} unreachable?")
+            # the SAME encoded frames: bytes, not re-serialization
+            self._sock.send_multipart(frames, copy=False)
+            entry[1] = now
+            entry[2] = n + 1
+            self.resends += 1
+
+    def result(self, req_id: int, timeout: Optional[float] = None) -> dict:
+        """Block until ``req_id``'s reply lands (resending past the
+        resend timer); raises :class:`InferenceError` on a service
+        refusal, TimeoutError when the service never answers."""
+        deadline = time.perf_counter() + (self.timeout if timeout is None
+                                          else float(timeout))
+        while req_id not in self._results:
+            if time.perf_counter() > deadline:
+                raise TimeoutError(f"req {req_id}: no reply within "
+                                   f"{self.timeout:g}s")
+            self._pump(0.05)
+            self._maybe_resend()
+        rep = self._results.pop(req_id)
+        if not rep.get("ok"):
+            self.errors += 1
+            raise InferenceError(rep)
+        return rep
+
+    def collect(self, wait_s: float = 0.0) -> List[dict]:
+        """Drain whatever replies are available right now (offered-load
+        driver); refusal replies are returned, not raised."""
+        self._pump(wait_s)
+        self._maybe_resend()
+        out = list(self._results.values())
+        self._results.clear()
+        return out
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    # -- synchronous API -------------------------------------------------------
+
+    def infer(self, x: np.ndarray,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """One request, one result: the (n, *out) result rows for the
+        (n, *sample) input (a bare sample comes back with its leading
+        1-row axis)."""
+        return self.result(self.submit(x), timeout=timeout)["y"]
+
+    def close(self) -> None:
+        self._sock.close(0)

@@ -1,0 +1,427 @@
+"""Inference service frontend: ZMQ ROUTER + dynamic batcher + model
+runner (ISSUE 4).
+
+Transport is the SAME wire-v3 codec the master/slave stack speaks
+(parallel/wire.py): every request/reply is multipart — one metadata
+frame plus one raw zero-copy buffer frame per tensor — so request
+payloads and result tensors never pass through pickle.  Clients connect
+DEALER sockets (many requests in flight, no REQ lockstep); the ROUTER
+envelope is carried through the batcher untouched and prepended to the
+reply, so replies route regardless of arrival order.
+
+Threading:
+
+  - the ROUTER thread owns the socket AND the codec: it decodes
+    requests, enqueues them on the batcher, answers control commands
+    (``ping``/``stats``) inline, refuses undecodable frames
+    (``bad_frames`` — the master's fault model extends to serving), and
+    drains the outbound reply queue;
+  - ONE compute thread drives the donated ping-pong: it coalesces a
+    batch, stages it (async H2D), dispatches the jitted forward
+    (donating the staged buffer), then — while the device computes —
+    coalesces AND stages the NEXT batch before materializing the
+    result, so staging of batch N+1 overlaps compute of batch N (the
+    ``loader/ingest.py`` overlap discipline).
+
+Fault model (README "Serving"): an undecodable or corrupted request
+frame is refused with an error reply and counted, never fatal; a
+request that would overflow the bounded queue is shed immediately with
+a readable reason; a request older than ``request_ttl_s`` by the time
+its batch closes is answered ``timed_out`` instead of computed.  The
+service survives a ChaosProxy soak (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+
+from .batcher import BucketLadder, DynamicBatcher, Request
+from .model import ModelRunner
+
+#: serving config home: ``root.common.serving.*`` (CLI dotted overrides
+#: reach it like every other knob)
+DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
+            "request_ttl_s": 5.0}
+
+
+def _cfg(name: str, override):
+    if override is not None:
+        return override
+    return root.common.serving.get(name, DEFAULTS[name])
+
+
+class InferenceServer:
+    """Serve a workflow's frozen forward over ZMQ.
+
+    ``bind`` may use a wildcard port (``tcp://127.0.0.1:*``); the
+    resolved address is in ``endpoint`` once serving starts.  Drive
+    blocking (``serve()``) or on a background thread (``start()`` /
+    ``stop()``).  ``max_requests`` makes serve() return after answering
+    that many inference requests (bench/launcher tests)."""
+
+    def __init__(self, workflow, bind: str = "tcp://127.0.0.1:*",
+                 snapshot: str = "", max_batch: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_bound: Optional[int] = None,
+                 request_ttl_s: Optional[float] = None,
+                 ladder: Optional[BucketLadder] = None,
+                 max_requests: Optional[int] = None,
+                 warmup: bool = True):
+        from znicz_tpu.parallel import wire
+
+        self.bind = bind
+        self.endpoint: Optional[str] = None      # resolved at serve()
+        self.runner = ModelRunner(workflow, snapshot=snapshot)
+        max_batch = int(_cfg("max_batch", max_batch))
+        self.batcher = DynamicBatcher(
+            max_batch=max_batch,
+            max_delay_ms=float(_cfg("max_delay_ms", max_delay_ms)),
+            queue_bound=int(_cfg("queue_bound", queue_bound)),
+            ladder=ladder)
+        self.request_ttl_s = float(_cfg("request_ttl_s", request_ttl_s))
+        self.max_requests = max_requests
+        self._warmup = warmup
+        self.codec = wire.Codec()           # router-thread only
+        self.requests_in = 0                # decoded infer requests
+        self.served = 0                     # answered with a result
+        self.timed_out = 0                  # answered timed_out (TTL)
+        self.rejected = 0                   # answered shed/oversized
+        self.started_at: Optional[float] = None
+        self._latencies: List[float] = []   # seconds, capped window
+        self._lat_cap = 8192
+        self._outbound: "queue.Queue" = queue.Queue()
+        self._wake_addr: Optional[str] = None    # set at serve() bind
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._serve_error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._compute_thread: Optional[threading.Thread] = None
+        self.log = logging.getLogger("znicz.serving")
+
+    # -- counters shorthand ----------------------------------------------------
+
+    @property
+    def bad_frames(self) -> int:
+        return self.codec.bad_frames
+
+    def qps(self) -> Optional[float]:
+        if self.started_at is None or not self.served:
+            return None
+        return self.served / max(time.perf_counter() - self.started_at,
+                                 1e-9)
+
+    def latency_quantiles(self) -> Dict[str, Optional[float]]:
+        lat = self._latencies[-self._lat_cap:]
+        if not lat:
+            return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+        a = np.asarray(lat) * 1e3
+        return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+                "p99_ms": round(float(np.percentile(a, 99)), 3),
+                "mean_ms": round(float(np.mean(a)), 3)}
+
+    def stats(self) -> Dict:
+        """The serving panel / bench record, one dict."""
+        out = {"endpoint": self.endpoint,
+               "requests_in": self.requests_in,
+               "served": self.served,
+               "rejected": self.rejected,
+               "timed_out": self.timed_out,
+               "bad_frames": self.codec.bad_frames,
+               "bytes_in": self.codec.bytes_in,
+               "bytes_out": self.codec.bytes_out,
+               "qps": None if self.qps() is None
+               else round(self.qps(), 2)}
+        out.update(self.latency_quantiles())
+        out["batcher"] = self.batcher.stats()
+        out["model"] = self.runner.stats()
+        return out
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self.serve, daemon=True,
+                                        name="znicz-serve")
+        self._thread.start()
+        if not self._ready.wait(timeout=120):
+            raise RuntimeError(f"inference server failed to come up on "
+                               f"{self.bind} within 120s")
+        if self._serve_error is not None:
+            # bind conflict / bad snapshot / warmup failure: surface the
+            # REAL cause immediately instead of a generic bind message
+            raise RuntimeError(
+                f"inference server failed on {self.bind}: "
+                f"{self._serve_error!r}") from self._serve_error
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until a ``start()``ed server exits (``max_requests``
+        reached, ``stop()`` called, or a fatal serve error)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- the ROUTER loop -------------------------------------------------------
+
+    def serve(self) -> None:
+        """Blocking serve; any failure (bind conflict, warmup compile
+        error) is recorded for ``start()`` to re-raise with its real
+        cause, and always unblocks a waiting ``start()``."""
+        try:
+            self._serve()
+        except BaseException as exc:
+            self._serve_error = exc
+            raise
+        finally:
+            self._ready.set()
+
+    def _serve(self) -> None:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.bind(self.bind)
+        self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+        # outbound wake-up: the compute thread pokes this inproc pair
+        # when it enqueues replies, so a finished batch ships on the
+        # NEXT poll wake instead of waiting out the poll timeout (the
+        # reply tax was the whole sequential-baseline RTT otherwise)
+        self._wake_addr = f"inproc://znicz-serve-wake-{id(self)}"
+        wake_r = ctx.socket(zmq.PULL)
+        wake_r.setsockopt(zmq.LINGER, 0)
+        wake_r.bind(self._wake_addr)
+        if self._warmup:
+            # compile every rung BEFORE taking traffic: first-request
+            # latency must not eat a compile, and the zero-recompile
+            # gate needs its baseline
+            self.runner.warmup(self.batcher.ladder)
+        self.started_at = time.perf_counter()
+        self._compute_thread = threading.Thread(
+            target=self._compute_loop, daemon=True, name="znicz-infer")
+        self._compute_thread.start()
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        poller.register(wake_r, zmq.POLLIN)
+        self._ready.set()
+        try:
+            while not self._stop.is_set():
+                if self.max_requests is not None and \
+                        self.served + self.timed_out + self.rejected \
+                        >= self.max_requests:
+                    break
+                if poller.poll(5):
+                    while True:             # drain queued wake tokens
+                        try:
+                            wake_r.recv(zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                    while True:             # drain every queued message
+                        try:
+                            frames = sock.recv_multipart(zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                        self._handle(sock, frames)
+                self._drain_outbound(sock)
+        finally:
+            self._stop.set()
+            self.batcher.close()
+            self._compute_thread.join(timeout=30)
+            self._drain_outbound(sock)      # flush final replies
+            sock.close(0)
+            wake_r.close(0)
+
+    def _drain_outbound(self, sock) -> None:
+        while True:
+            try:
+                envelope, rep, t_enqueued = self._outbound.get_nowait()
+            except queue.Empty:
+                return
+            if t_enqueued is not None:
+                lat = time.perf_counter() - t_enqueued
+                self._latencies.append(lat)
+                if len(self._latencies) > 2 * self._lat_cap:
+                    del self._latencies[:self._lat_cap]
+            # copy=False: result frames are memoryviews of arrays owned
+            # by the reply dicts, never mutated after encode
+            sock.send_multipart(
+                list(envelope) + self.codec.encode(rep), copy=False)
+
+    def _handle(self, sock, frames: List[bytes]) -> None:
+        from znicz_tpu.parallel import wire
+
+        envelope, payload = wire.split_envelope(frames)
+        if not envelope and frames:
+            # a bare-DEALER peer whose metadata frame is garbage: no
+            # delimiter, no magic — but this socket is a ROUTER, so the
+            # FIRST frame is always the peer identity; peel it so the
+            # refusal below stays routable
+            envelope, payload = list(frames[:1]), list(frames[1:])
+        try:
+            req, _ = self.codec.decode(payload)
+            if not isinstance(req, dict):
+                raise wire.WireError(
+                    f"decodes to {type(req).__name__}, not a request dict")
+        except Exception as exc:
+            self.log.warning("refused undecodable request (%d frames): %s "
+                             "— bad_frames=%d", len(frames), exc,
+                             self.codec.bad_frames + 1)
+            sock.send_multipart(
+                list(envelope)
+                + self.codec.refusal(f"bad frame: {exc}", legacy=False))
+            return
+        cmd = req.get("cmd")
+        rid = req.get("req_id")
+        if cmd == "ping":
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": True, "pong": True, "req_id": rid}))
+            return
+        if cmd == "stats":
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": True, "stats": self.stats(), "req_id": rid}))
+            return
+        if cmd != "infer":
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "error": f"unknown cmd {cmd!r}"}))
+            return
+        x = req.get("x")
+        if not isinstance(x, np.ndarray) or x.ndim < 1:
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "error": "infer request carries no tensor 'x'"}))
+            return
+        if x.ndim == len(self.runner.sample_shape):
+            x = x[None]                     # single sample shorthand
+        if tuple(x.shape[1:]) != self.runner.sample_shape:
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "error": f"sample shape {tuple(x.shape[1:])} != model "
+                          f"input {self.runner.sample_shape}"}))
+            return
+        if not np.can_cast(x.dtype, self.runner.dtype,
+                           casting="same_kind"):
+            # e.g. float samples sent to a u8-storage model: the
+            # assemble cast would silently wrap/truncate them into
+            # garbage bytes and the service would answer confidently
+            # wrong — refuse readably like a wrong shape instead
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "error": f"sample dtype {x.dtype} cannot safely cast "
+                          f"to the model's storage dtype "
+                          f"{self.runner.dtype}"}))
+            return
+        self.requests_in += 1
+        reason = self.batcher.submit(
+            Request(x, x.shape[0], reply_to=list(envelope), req_id=rid))
+        if reason is not None:
+            self.rejected += 1
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "rejected": True, "req_id": rid,
+                 "error": reason}))
+
+    # -- the compute loop (donated ping-pong) ----------------------------------
+
+    def _assemble(self, batch: List[Request]):
+        """Coalesced requests -> (live requests, staged device buffer).
+        TTL-expired requests are answered ``timed_out`` here — computing
+        them would waste a batch slot on an answer nobody is waiting
+        for.  Returns None when the whole batch expired."""
+        now = time.perf_counter()
+        live = []
+        for r in batch:
+            if now - r.t_enqueued > self.request_ttl_s:
+                self.timed_out += 1
+                self._outbound.put((r.reply_to, {
+                    "ok": False, "timed_out": True, "req_id": r.req_id,
+                    "error": f"request waited past request_ttl_s="
+                             f"{self.request_ttl_s:g}"}, None))
+                continue
+            live.append(r)
+        if not live:
+            return None
+        rows = sum(r.n for r in live)
+        bucket = self.batcher.ladder.bucket_for(rows)
+        x = np.zeros((bucket,) + self.runner.sample_shape,
+                     self.runner.dtype)
+        off = 0
+        for r in live:
+            x[off:off + r.n] = np.asarray(r.x, self.runner.dtype) \
+                .reshape((r.n,) + self.runner.sample_shape)
+            off += r.n
+        return live, self.runner.stage(x)
+
+    def _finish(self, live: List[Request], y_dev) -> None:
+        y = np.asarray(y_dev)               # the sync point
+        off = 0
+        for r in live:
+            # slice-copy: each reply owns its rows (the padded tail is
+            # dropped here — pad rows never leave the server)
+            self._outbound.put((r.reply_to, {
+                "ok": True, "req_id": r.req_id,
+                "y": np.array(y[off:off + r.n])}, r.t_enqueued))
+            off += r.n
+            self.served += 1
+
+    def _compute_loop(self) -> None:
+        import zmq
+
+        wake = zmq.Context.instance().socket(zmq.PUSH)
+        wake.setsockopt(zmq.LINGER, 0)
+        wake.connect(self._wake_addr)
+
+        def poke():
+            try:
+                wake.send(b"", zmq.NOBLOCK)
+            except zmq.Again:           # router already has wakes queued
+                pass
+
+        staged = None
+        try:
+            while True:
+                if staged is None:
+                    batch = self.batcher.next_batch(timeout=0.05)
+                    if batch is None:
+                        if self._stop.is_set():
+                            return
+                        continue
+                    staged = self._assemble(batch)
+                    if staged is None:
+                        poke()          # TTL refusals queued: ship them
+                        continue
+                live, x_dev = staged
+                # dispatch is async; the staged buffer is DONATED into
+                # the step (ping-pong half 1)
+                y_dev = self.runner.infer_staged(x_dev)
+                staged = None
+                # while the device computes batch N, grab-and-stage what
+                # is ALREADY queued as batch N+1 (ping-pong half 2: at
+                # most two input buffers ever exist — the donated one
+                # and this one).  wait_fill=False: a coalescing window
+                # here would hold batch N's finished replies hostage
+                nxt = self.batcher.next_batch(timeout=0.0,
+                                              wait_fill=False)
+                if nxt is not None:
+                    staged = self._assemble(nxt)
+                self._finish(live, y_dev)
+                poke()                  # replies queued: wake the router
+        except Exception:
+            # a compute-thread death must not strand clients silently
+            self.log.exception("inference compute loop died")
+            self._stop.set()
+            self.batcher.close()
+        finally:
+            wake.close(0)

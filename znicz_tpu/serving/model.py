@@ -1,0 +1,146 @@
+"""ModelRunner: a trained workflow frozen into an inference-only jitted
+forward (ISSUE 4).
+
+The forward IS ``FusedTrainer.forward_pass`` with ``train=False`` — the
+same pure composition of the units' own ``apply`` code the training fast
+path differentiates, so serving computes exactly the function training
+optimized (the batched-vs-unbatched 0-ULP parity test in
+tests/test_serving.py rides on the row-independence of that graph).
+Params are extracted once at construction and pinned on device; every
+call passes them as an un-donated operand, so one params tree serves
+every bucket's executable.
+
+**Bucketed jit cache**: the runner jits ONE function of ``(params, x)``;
+each distinct padded batch shape (a ladder rung) compiles exactly once
+and is a cache hit forever after.  ``compiles`` counts TRACES — the
+counter ticks inside the traced function body, which Python only runs
+when jax actually (re)traces, i.e. once per cache entry — and
+``jit_cache_size()`` cross-checks it against jax's own pjit cache, so
+"zero recompiles after warmup" is provable from the outside
+(bench.py --serve's gate).
+
+**Donated ping-pong staging**: ``stage`` starts an async host->device
+put and ``infer_staged`` DONATES that buffer into the jitted call
+(``donate_argnums``), so at any moment at most two input buffers exist —
+the one the device is consuming (its memory reusable for activations
+the instant the gather reads it) and the one the next batch is staging
+into.  The frontend's compute loop overlaps stage(N+1) with compute(N),
+the same overlap discipline as ``loader/ingest.py``'s prefetch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ModelRunner:
+    """Freeze a built+initialized workflow's params into a jitted
+    inference forward.  ``snapshot`` restores params first (the
+    snapshotter's inference-load path — no velocities, no trainer
+    state).  The output is the last unit's output: LOGITS for a softmax
+    head (clients softmax if they want probabilities), the raw
+    reconstruction for MSE heads."""
+
+    def __init__(self, workflow, snapshot: str = "",
+                 donate: Optional[bool] = None):
+        import jax
+
+        from znicz_tpu.parallel.fused import FusedTrainer
+
+        if donate is None:
+            # donation is a TPU/GPU lever; the CPU runtime ignores it
+            # (and warns per compile), so auto-resolve by backend — the
+            # serving STRUCTURE (stage N+1 while N computes) is identical
+            # either way, only the buffer reuse is backend-dependent
+            donate = jax.default_backend() != "cpu"
+        self.donate = bool(donate)
+
+        if snapshot:
+            from znicz_tpu import snapshotter
+
+            snapshotter.load_inference(workflow, snapshot)
+        self.workflow = workflow
+        self._trainer = FusedTrainer(workflow)
+        self.params = self._trainer.extract_params()
+        #: per-sample input shape the service accepts (requests carry
+        #: (n, *sample_shape) arrays)
+        self.sample_shape: Tuple[int, ...] = tuple(
+            int(d) for d in workflow.forwards[0].input.shape[1:])
+        mem = getattr(workflow.loader.original_data, "mem", None)
+        #: staging dtype — u8 datasets keep their 1-byte wire/HBM form,
+        #: the in-graph decode (trainer._decode) widens on device
+        self.dtype = np.dtype(mem.dtype) if mem is not None \
+            else np.dtype(np.float32)
+        self.compiles = 0               # traces of _fwd == cache entries
+        key = self._trainer._key0       # eval path never consumes it
+
+        def fwd(params, x):
+            # trace-time tick: Python runs this body once per compile
+            # (cache hits replay the compiled executable only)
+            self.compiles += 1
+            t = self._trainer
+            return t.forward_pass(params, t._decode(x), key, train=False)
+
+        self._fwd = jax.jit(fwd, donate_argnums=(1,) if self.donate
+                            else ())
+
+    # -- the two halves of the ping-pong ---------------------------------------
+
+    def stage(self, x: np.ndarray):
+        """Host batch -> device buffer.  The put is dispatched
+        asynchronously, so calling this while a previous ``infer_staged``
+        is still computing overlaps the H2D copy with that compute."""
+        import jax
+
+        return jax.device_put(np.ascontiguousarray(x, self.dtype))
+
+    def infer_staged(self, x_dev):
+        """Dispatch the forward on an already-staged (device) batch and
+        return the un-materialized device result.  ``x_dev`` is DONATED
+        (where the backend supports donation — see ``donate``); callers
+        must not reuse it after this call either way."""
+        return self._fwd(self.params, x_dev)
+
+    # -- conveniences ----------------------------------------------------------
+
+    def infer(self, x: np.ndarray) -> np.ndarray:
+        """Synchronous forward of one host batch (tests, warmup, the
+        sequential baseline)."""
+        return np.asarray(self.infer_staged(self.stage(x)))
+
+    def pad(self, x: np.ndarray, bucket: int) -> np.ndarray:
+        """Zero-pad a (n, *sample) batch up to ``bucket`` rows.  The
+        forward is row-independent, so pad rows cannot perturb real
+        rows; the caller slices the first n output rows back out."""
+        n = x.shape[0]
+        if n == bucket:
+            return x
+        out = np.zeros((bucket,) + tuple(x.shape[1:]), self.dtype)
+        out[:n] = x
+        return out
+
+    def warmup(self, ladder) -> int:
+        """Compile every ladder rung's executable up front; returns the
+        compile count afterwards — the zero-recompiles baseline the
+        serving gates compare against."""
+        for rung in ladder:
+            self.infer(np.zeros((rung,) + self.sample_shape, self.dtype))
+        return self.compiles
+
+    def jit_cache_size(self) -> Optional[int]:
+        """jax's own executable-cache entry count for the jitted forward
+        (the jax._src pjit cache behind ``_cache_size``); None where the
+        jax version does not expose it.  After warmup this equals
+        ``compiles`` and must stay put."""
+        try:
+            return int(self._fwd._cache_size())
+        except Exception:               # pragma: no cover - jax-version dep
+            return None
+
+    def stats(self) -> Dict:
+        return {"compiles": self.compiles,
+                "jit_cache_size": self.jit_cache_size(),
+                "sample_shape": list(self.sample_shape),
+                "dtype": str(self.dtype)}

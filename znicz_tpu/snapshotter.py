@@ -140,6 +140,40 @@ def restore(workflow, snap: Dict) -> None:
         stream.state.bit_generator.state = state
 
 
+def restore_inference(workflow, snap: Dict) -> None:
+    """The INFERENCE-load path (ISSUE 4): apply ONLY the forward params
+    onto a built+initialized workflow.  Velocities, loader cursors,
+    decision state and prng streams are training state a serving process
+    neither has nor wants — restoring them would couple the service to a
+    loader/decision graph it never runs.  Raises on a snapshot whose
+    units don't cover the workflow's weighted forwards (serving half a
+    model silently would answer garbage)."""
+    from znicz_tpu.nn_units import ForwardBase
+
+    units = snap.get("units") or {}
+    missing = [f.name for f in workflow.forwards
+               if getattr(f, "has_weights", False) and f.name not in units]
+    if missing:
+        raise ValueError(
+            f"snapshot has no params for weighted forward(s) {missing}; "
+            f"it covers {sorted(units)} — wrong snapshot for this "
+            "workflow?")
+    for unit in workflow:
+        if isinstance(unit, ForwardBase) and unit.name in units:
+            for k, a in unit.params().items():
+                a.mem = np.asarray(units[unit.name][k]).copy()
+
+
+def load_inference(workflow, path: str) -> Dict:
+    """Load ``path`` and :func:`restore_inference` it; returns the
+    snapshot's metadata (epoch/metric/config — the serving panel shows
+    what checkpoint is live) without the param arrays."""
+    snap = Snapshotter.load(path)
+    restore_inference(workflow, snap)
+    return {k: v for k, v in snap.items()
+            if k not in ("units", "velocities")}
+
+
 def _refuse_cross_host(fmt: str, name: str) -> None:
     """The ONE policy message for 'host-format saves need replicated
     state' — raised by both the sync (unit-Array) and async (raw jax

@@ -29,6 +29,7 @@ class WebStatus:
         self.port = int(port)
         self.workflows: List[object] = []
         self.server = None                  # optional master (topology)
+        self.inference = None               # optional inference service
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -39,6 +40,12 @@ class WebStatus:
     def register_server(self, server) -> None:
         """Show the master/slave topology (reference dashboard feature)."""
         self.server = server
+
+    def register_inference(self, server) -> None:
+        """Show the inference service's serving panel (ISSUE 4): qps,
+        latency quantiles, batch occupancy, queue depth, per-bucket hit
+        counts, shed/timed-out/bad-frame accounting."""
+        self.inference = server
 
     # -- snapshotting the state (host side, lock-free reads) -------------------
 
@@ -120,6 +127,10 @@ class WebStatus:
                      "last_seen_s": round(now - seen, 1)}
                     for sid, seen in sorted(dead.items())],
             }
+        if self.inference is not None:
+            # stats() assembles from plain counters — safe to call from
+            # this HTTP thread while the service runs
+            out["serving"] = self.inference.stats()
         return out
 
     # -- server ----------------------------------------------------------------
@@ -174,6 +185,34 @@ class WebStatus:
                             f"</th><th>last seen</th></tr>{srows}</table>"
                             f"<p>dead slaves: {len(master['dead_slaves'])}"
                             "</p>")
+                    serving_html = ""
+                    serving = snap.get("serving")
+                    if serving:
+                        b = serving["batcher"]
+                        m = serving["model"]
+                        brows = "".join(
+                            f"<tr><td>{r}</td><td>{n}</td></tr>"
+                            for r, n in sorted(b["bucket_hits"].items()))
+                        serving_html = (
+                            "<h2>Serving "
+                            f"{html.escape(str(serving['endpoint']))}</h2>"
+                            f"<p>qps: {serving['qps']}, p50: "
+                            f"{serving['p50_ms']} ms, p99: "
+                            f"{serving['p99_ms']} ms, served: "
+                            f"{serving['served']}, rejected: "
+                            f"{serving['rejected']}, timed out: "
+                            f"{serving['timed_out']}, bad frames: "
+                            f"{serving['bad_frames']}</p>"
+                            f"<p>batcher: occupancy "
+                            f"{b['mean_occupancy']}, queue depth "
+                            f"{b['queue_depth']}/{b['queue_bound']} rows, "
+                            f"shed {b['shed']}, max_batch "
+                            f"{b['max_batch']}, max_delay "
+                            f"{b['max_delay_ms']} ms; jit compiles "
+                            f"{m['compiles']} (cache "
+                            f"{m['jit_cache_size']})</p>"
+                            "<table border=1><tr><th>bucket</th>"
+                            f"<th>hits</th></tr>{brows}</table>")
                     body = (
                         "<html><head><meta http-equiv='refresh' content='2'>"
                         "<title>znicz-tpu status</title></head><body>"
@@ -181,7 +220,7 @@ class WebStatus:
                         "<h2>Workflows</h2><table border=1>"
                         "<tr><th>name</th><th>epoch</th><th>best</th>"
                         f"<th>state</th></tr>{rows}</table>"
-                        f"{master_html}"
+                        f"{master_html}{serving_html}"
                         "</body></html>").encode()
                     ctype = "text/html"
                 self.send_response(200)
