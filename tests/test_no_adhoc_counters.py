@@ -1,0 +1,73 @@
+"""CI lint (ISSUE 5 satellite): no NEW ad-hoc counter attributes.
+
+PRs 1-4 each grew bespoke ``self.<name> += 1`` counters (``bad_frames``,
+``prefetch_hits``, ``shed``, ...), readable only through whichever panel
+their owner happened to wire up.  ISSUE 5 moved them all into the
+telemetry registry (znicz_tpu/telemetry/), where every counter is
+exported uniformly on ``/metrics``.  This test greps the package for
+counter-suffixed bare increments so a future PR cannot regress into
+ad-hoc accounting: a new counter must either go through
+``telemetry.scope(...).counter(...)`` or be added to the ALLOWLIST
+below with a one-line justification.
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "znicz_tpu"
+
+#: attribute-name suffixes that mean "this is a counter": the union of
+#: every counter name the registry migration absorbed, so the regression
+#: class is exactly "a counter like the ones we already centralized"
+SUFFIXES = ("count", "total", "hits", "frames", "saves", "done",
+            "requeued", "reconnects", "replies", "registrations",
+            "updates", "rejected", "shed", "oversized", "compiles",
+            "received", "served", "batches", "errors", "resends")
+
+PATTERN = re.compile(
+    r"^\s*self\.(?P<name>[a-z0-9_]*(?:" + "|".join(SUFFIXES)
+    + r"))\s*\+=", re.M)
+
+#: (path-relative-to-znicz_tpu, attribute) pairs that look counter-ish
+#: but are STATE, not metrics — each with its reason
+ALLOWLIST = {
+    # PRNG/step-key stream position: training semantics (jax_key(step)),
+    # not accounting; mirrored into the registry as trainer/train_steps
+    ("parallel/fused.py", "steps_done"),
+    # loader cursor over the resident set (drives epoch bookkeeping)
+    ("loader/base.py", "samples_served"),
+    # graphics PUB/SUB frame cursor on the plotting side-channel
+    ("graphics.py", "received"),
+    # kohonen epoch accumulators (averaged into qerror / the winners
+    # histogram, then reset)
+    ("kohonen.py", "_batches"),
+    ("kohonen.py", "total"),
+}
+
+
+def test_no_adhoc_counters_outside_the_registry():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        if rel.startswith("telemetry/"):
+            continue                    # the registry implements itself
+        text = path.read_text()
+        for m in PATTERN.finditer(text):
+            name = m.group("name")
+            if (rel, name) in ALLOWLIST:
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            offenders.append(f"{rel}:{line}: self.{name} += ...")
+    assert not offenders, (
+        "ad-hoc counter increments found — register them in "
+        "znicz_tpu/telemetry instead (telemetry.scope(...).counter(...)"
+        ".inc()), or allowlist non-metric state with a justification:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_lint_pattern_catches_the_regression_class():
+    """The pattern must actually fire on the style it polices."""
+    assert PATTERN.search("        self.bad_frames += 1")
+    assert PATTERN.search("self.retry_count += n")
+    assert not PATTERN.search("self._pos += 1")          # cursor, not metric
+    assert not PATTERN.search("unit.run_count += 1")     # not self.

@@ -33,6 +33,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from znicz_tpu.loader.base import TRAIN
+# the shared ISSUE-5 compat layer; each Counter carries its own lock, so
+# the prefetcher thread and the main loop increment concurrently without
+# losing counts (regression-tested in tests/test_telemetry.py)
+from znicz_tpu.telemetry.metrics import registered_property as \
+    _client_counter
 
 
 class _BadReply(Exception):
@@ -139,7 +144,7 @@ class _JobPrefetcher:
                     # starved receive: same EFSM rule as the main loop —
                     # the socket can never be reused; reconnect fresh on
                     # the next fetch
-                    self._client.prefetch_reconnects += 1
+                    self._client._m["prefetch_reconnects"].inc()
                     if sock is not None:
                         sock.close(0)
                         sock = None
@@ -148,8 +153,8 @@ class _JobPrefetcher:
                     # holds bad-reply counters to the corrupt-frame
                     # count, so ONLY real replies may tick this) and
                     # mirror the main loop's fresh-socket policy
-                    self._client.prefetch_bad_replies += 1
-                    self._client.prefetch_reconnects += 1
+                    self._client._m["prefetch_bad_replies"].inc()
+                    self._client._m["prefetch_reconnects"].inc()
                     if sock is not None:
                         sock.close(0)
                         sock = None
@@ -162,7 +167,7 @@ class _JobPrefetcher:
                     logging.getLogger("znicz").warning(
                         "%s: prefetch fetch failed", self._client.slave_id,
                         exc_info=True)
-                    self._client.prefetch_reconnects += 1
+                    self._client._m["prefetch_reconnects"].inc()
                     if sock is not None:
                         sock.close(0)
                         sock = None
@@ -176,17 +181,31 @@ class _JobPrefetcher:
 
 
 class Client:
+    #: client counters registered under component="slave" (ISSUE 5):
+    #: name -> HELP text
+    COUNTERS = {
+        "jobs_done": "jobs completed",
+        "reconnects": "fresh-socket retries (main loop)",
+        "bad_replies": "undecodable replies",  # shared family
+        "prefetch_hits": "jobs consumed from the prefetcher",
+        "prefetch_reconnects": "fresh-socket retries (prefetcher)",
+        "prefetch_bad_replies": "undecodable replies (prefetcher)",
+    }
+
+    # (historical attribute properties generated from COUNTERS after
+    # the FusedClient definition at the bottom of this module)
+
     def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
                  slave_id: Optional[str] = None):
+        from znicz_tpu import telemetry
+
         self.workflow = workflow
         self.endpoint = endpoint
         self.slave_id = slave_id or uuid.uuid4().hex[:8]
-        self.jobs_done = 0
-        self.reconnects = 0             # fresh-socket retries (main loop)
-        self.bad_replies = 0            # undecodable replies (main loop)
-        self.prefetch_hits = 0          # jobs consumed from the prefetcher
-        self.prefetch_reconnects = 0    # fresh-socket retries (prefetcher)
-        self.prefetch_bad_replies = 0   # undecodable replies (prefetcher)
+        _sc = telemetry.scope("slave")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._tracer = telemetry.tracer()
         self.wire_dtype = "float32"     # resolved from config in run()
         self._delta_encoder = None
 
@@ -380,7 +399,7 @@ class Client:
             """Fresh socket + backoff; False when the budget is spent."""
             nonlocal sock, registered, failures
             if isinstance(exc, _BadReply):
-                self.bad_replies += 1
+                self._m["bad_replies"].inc()
             failures += 1
             if not ever_registered:
                 if failures >= connect_retries:
@@ -394,7 +413,7 @@ class Client:
                     "(master gone for good?)", self.slave_id, failures - 1)
                 return False
             sock.close(0)               # EFSM: unusable after a timeout
-            self.reconnects += 1
+            self._m["reconnects"].inc()
             registered = False
             delay = min(backoff_cap,
                         backoff_base * (2 ** min(failures - 1, 16)))
@@ -444,7 +463,7 @@ class Client:
                         log.warning("%s: master quarantined our delta: %s",
                                     self.slave_id, rep.get("error"))
                     update_frames = None
-                    self.jobs_done += 1
+                    self._m["jobs_done"].inc()
                     continue
                 # -- next job: the prefetcher's pipelined fetch first ----
                 rep = None
@@ -453,7 +472,7 @@ class Client:
                     if rep is not None:
                         failures = 0    # a reply is a reply: master alive
                         if "job" in rep:
-                            self.prefetch_hits += 1
+                            self._m["prefetch_hits"].inc()
                 if rep is None:
                     try:
                         rep = self._rpc(sock, {"cmd": "job"})
@@ -488,11 +507,17 @@ class Client:
                 before = {name: {k: np.asarray(v) for k, v in layer.items()}
                           for name, layer in params.items()}
                 train = bool(rep.get("train"))
-                metrics = self._run_minibatch(job, train)
-                deltas = self._deltas_since(before) if train else None
+                # span correlated to the master's job by trace_id — the
+                # cross-process join key a merged Perfetto view uses
+                with self._tracer.span(
+                        "slave", "job", job_id=rep.get("job_id"),
+                        trace_id=rep.get("trace_id"), train=train):
+                    metrics = self._run_minibatch(job, train)
+                    deltas = self._deltas_since(before) if train else None
                 update_frames, _ = wire.encode_message(
                     {"cmd": "update", "id": self.slave_id,
                      "job_id": rep["job_id"],
+                     "trace_id": rep.get("trace_id"),
                      "deltas": self._delta_encoder.encode(deltas),
                      "metrics": metrics})
         finally:
@@ -601,3 +626,8 @@ class FusedClient(Client):
                     m["confusion"] = np.asarray(conf_sum)
             metrics.append(m)
         return metrics if "minibatches" in job else metrics[0]
+
+
+for _name, _help in Client.COUNTERS.items():
+    setattr(Client, _name, _client_counter(_name, _help))
+del _name, _help

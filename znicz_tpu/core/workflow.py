@@ -106,11 +106,14 @@ class Workflow(Unit):
 
     def run(self) -> None:
         """Run the control graph until EndPoint fires (or nothing is ready)."""
+        from znicz_tpu import telemetry
+
         if not self.is_initialized:
             self.initialize()
         self.stopped.set(False)
         for unit in self.units:
             unit.reset_links()
+        tracer = telemetry.tracer()
         self._run_time_started = time.perf_counter()
         queue: deque[Unit] = deque([self.start_point])
         queued = {self.start_point}
@@ -122,8 +125,13 @@ class Workflow(Unit):
             if not bool(unit.gate_skip):
                 started = time.perf_counter()
                 unit.run()
-                unit.run_time += time.perf_counter() - started
+                elapsed = time.perf_counter() - started
+                unit.run_time += elapsed
                 unit.run_count += 1
+                if tracer.enabled:
+                    # reuse the timing above: one deque append per unit
+                    # firing, no extra clock reads (ISSUE 5 span site)
+                    tracer.add("unit", unit.name, started, elapsed)
             for target in unit.links_to:
                 target.links_from[unit] = True
                 fire = (any(target.links_from.values())

@@ -55,6 +55,23 @@ class DecisionBase(Unit):
         self.best_epoch = -1
         self._fails = 0
         self.on_epoch_end = []                    # callbacks(decision)
+        # telemetry (ISSUE 5): the decision loop's live state as
+        # collect-time gauges — zero hot-path writes, the scrape reads
+        # the attributes this unit already maintains.  weak_fn: the
+        # process-global registry must not pin the decision (and the
+        # whole workflow graph behind its links) after the run
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("decision")
+        _sc.gauge("epoch_number", "current epoch",
+                  fn=telemetry.weak_fn(
+                      self, lambda d: float(d.epoch_number)))
+        _sc.gauge("best_metric", "best validation metric so far",
+                  fn=telemetry.weak_fn(
+                      self, lambda d: float(d.best_metric)))
+        _sc.gauge("train_complete", "1 once training stopped",
+                  fn=telemetry.weak_fn(
+                      self, lambda d: float(bool(d.complete))))
 
     # -- metric plumbing (subclasses refine) ----------------------------------
 

@@ -69,6 +69,14 @@ class Launcher:
         parser.add_argument("--profile", default="",
                             help="capture a jax.profiler trace of the whole "
                                  "run into this directory")
+        parser.add_argument("--profile-dir", default="", metavar="DIR",
+                            help="programmatic jax profiler capture "
+                                 "(start_trace/stop_trace) into DIR, with "
+                                 "every fused train step wrapped in a "
+                                 "jax.profiler.StepTraceAnnotation so the "
+                                 "timeline shows named steps (telemetry, "
+                                 "ISSUE 5; supersedes --profile when both "
+                                 "are given)")
         parser.add_argument("--fused", action="store_true",
                             help="train with the fused SPMD fast path "
                                  "(one jitted scan step) instead of the "
@@ -174,7 +182,23 @@ class Launcher:
         sig = inspect.signature(mod.run)
         if "snapshot" in sig.parameters and args.snapshot:
             kwargs["snapshot"] = args.snapshot
-        if args.profile:
+        if args.profile_dir:
+            # programmatic capture (TPU hand-off protocol, BASELINE.md):
+            # unlike the --profile context manager this pairs with the
+            # telemetry step annotations, so the profiler timeline shows
+            # one named StepTraceAnnotation block per fused train step
+            import jax
+
+            from znicz_tpu import telemetry
+
+            telemetry.set_profile_steps(True)
+            jax.profiler.start_trace(args.profile_dir)
+            try:
+                wf = mod.run(**kwargs)
+            finally:
+                jax.profiler.stop_trace()
+                print(f"profiler trace -> {args.profile_dir}/")
+        elif args.profile:
             import jax
 
             with jax.profiler.trace(args.profile):

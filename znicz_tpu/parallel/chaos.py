@@ -117,16 +117,33 @@ class ChaosProxy:
 
     def __init__(self, front_endpoint: str, back_endpoint: str,
                  schedule: FaultSchedule):
+        from znicz_tpu import telemetry
+
         self.front_endpoint = front_endpoint
         self.back_endpoint = back_endpoint
         self.schedule = schedule
-        self.counters: Dict[str, Dict[str, int]] = {
-            d: {a: 0 for a in ACTIONS} for d in ("req", "rep")}
+        # fault accounting lives in the telemetry registry (ISSUE 5):
+        # one labeled family znicz_faults_total{component="chaos",
+        # direction=..., action=...}; ``counters`` below keeps the
+        # historical nested-dict READ shape the chaos tests hold their
+        # robustness-counter accounting against
+        _sc = telemetry.scope("chaos")
+        self._fault_counters = {
+            (d, a): _sc.counter("faults", "injected proxy fault decisions",
+                                direction=d, action=a)
+            for d in ("req", "rep") for a in ACTIONS}
         self.log: List[Tuple[int, str, str]] = []
         self._frame_no = 0
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        """``{direction: {action: count}}`` snapshot of the registry
+        counters (the historical read shape)."""
+        return {d: {a: self._fault_counters[(d, a)].value for a in ACTIONS}
+                for d in ("req", "rep")}
 
     def faults_toward(self, direction: str) -> int:
         """Injected faults a peer in ``direction``'s receive path can
@@ -209,7 +226,7 @@ class ChaosProxy:
                     out = back if sock is front else front
                     fno = self._frame_no
                     action, delay = self.schedule.decide(fno)
-                    self.counters[direction][action] += 1
+                    self._fault_counters[(direction, action)].inc()
                     self.log.append((fno, direction, action))
                     self._frame_no += 1
                     if action == "drop":

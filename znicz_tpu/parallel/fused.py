@@ -144,6 +144,21 @@ class FusedTrainer:
                       "warm_steps": 0, "warm_images": 0, "warm_wall_s": 0.0,
                       "warm_img_per_sec": 0.0}
         workflow.fused_stats = self.stats
+        # telemetry (ISSUE 5): hot-loop metrics + spans.  The registry
+        # counters/histogram observe only while telemetry is enabled —
+        # bench.py --telemetry gates the whole layer's cost (<2%) by
+        # interleaving enabled/disabled windows of this very loop.
+        from znicz_tpu import telemetry
+
+        self._telemetry = telemetry
+        self._tracer = telemetry.tracer()
+        _sc = telemetry.scope("trainer")
+        self._m_train_steps = _sc.counter("train_steps",
+                                          "fused train steps dispatched")
+        self._m_images = _sc.counter("images", "training images consumed")
+        self._m_step_seconds = _sc.histogram(
+            "step_seconds", "per-step wall time (pipelined intervals)",
+            size=4096)
         self.compute_dtype = (np.dtype("float32")
                               if root.common.engine.get("precision",
                                                         "float32")
@@ -785,6 +800,14 @@ class FusedTrainer:
             else max(t0, self._acct_last_end)
         dt = max(now - start, 1e-9)
         self._acct_last_end = now
+        if self._tracer.enabled:            # the optional layer (ISSUE 5)
+            self._m_step_seconds.observe(dt / max(n_steps + n_eval, 1))
+        if is_train:
+            # accounting, not overhead-sensitive spans: progress counters
+            # keep moving even with telemetry disabled (a dashboard
+            # watching train_steps must never read a live run as stalled)
+            self._m_train_steps.inc(n_steps)
+            self._m_images.inc(n_images)
         stats["wall_s"] += dt
         stats["last_step_ms"] = round(dt / (n_steps + n_eval) * 1e3, 3)
         if is_train:
@@ -1181,6 +1204,7 @@ class FusedTrainer:
                 return
             seg, kind, res, t0 = inflight
             inflight = None
+            t_flush = _time.perf_counter()
             if kind == "single":
                 loss, n_err, conf = res
                 epoch_conf = conf if epoch_conf is None \
@@ -1193,6 +1217,12 @@ class FusedTrainer:
                 losses, n_errs = (np.asarray(m) for m in ms)
                 stacked = [(losses[i], n_errs[i], None)
                            for i in range(len(seg))]
+            if self._tracer.enabled:
+                # the host-sync span: waiting out the previous dispatch's
+                # device work + pulling its metrics
+                self._tracer.add("train", "flush", t_flush,
+                                 _time.perf_counter() - t_flush,
+                                 {"steps": len(seg), "kind": kind})
             for s, m in zip(seg, stacked):
                 feed_decision(s, m)
             account(len(seg), sum(s["size"] for s in seg), t0, True,
@@ -1227,36 +1257,47 @@ class FusedTrainer:
                                               self.steps_done + len(seg),
                                               dtype=np.int32)))
 
-                    if staging:
-                        # staged-direct: minibatches ride in the scan xs
-                        # (even a lone step goes through the K=1 scan)
-                        dseg, tseg = self._stage_direct(
-                            [s["idx"] for s in seg], put)
-                        bs_vec, steps = seg_ops()
-                        params, velocities, ms, conf_sum = \
-                            self._train_scan(
-                                params, velocities,
-                                put(hypers_rows(len(seg))), dseg, tseg,
-                                bs_vec, put(gen.jax_base_key()), steps)
-                        result = ("scan", (ms, conf_sum))
-                    elif len(seg) == 1:
-                        key = gen.jax_key(self.steps_done)
-                        params, velocities, metrics = self._train_step(
-                            params, velocities, self.hypers(), dataset,
-                            targets, put(seg[0]["idx"]),
-                            np.int32(seg[0]["size"]), key)
-                        advance_lr()
-                        result = ("single", metrics)
-                    else:
-                        idx_op = put(np.stack([s["idx"] for s in seg]))
-                        bs_vec, steps = seg_ops()
-                        params, velocities, ms, conf_sum = \
-                            self._train_scan(
-                                params, velocities,
-                                put(hypers_rows(len(seg))), dataset,
-                                targets, idx_op, bs_vec,
-                                put(gen.jax_base_key()), steps)
-                        result = ("scan", (ms, conf_sum))
+                    # ISSUE 5: named profiler step (--profile-dir) +
+                    # a dispatch span; t_disp measures HOST dispatch time
+                    # (the device work lands in flush()'s sync span)
+                    t_disp = _time.perf_counter()
+                    step0 = self.steps_done
+                    with self._telemetry.step_annotation(step0):
+                        if staging:
+                            # staged-direct: minibatches ride in the scan xs
+                            # (even a lone step goes through the K=1 scan)
+                            dseg, tseg = self._stage_direct(
+                                [s["idx"] for s in seg], put)
+                            bs_vec, steps = seg_ops()
+                            params, velocities, ms, conf_sum = \
+                                self._train_scan(
+                                    params, velocities,
+                                    put(hypers_rows(len(seg))), dseg, tseg,
+                                    bs_vec, put(gen.jax_base_key()), steps)
+                            result = ("scan", (ms, conf_sum))
+                        elif len(seg) == 1:
+                            key = gen.jax_key(self.steps_done)
+                            params, velocities, metrics = self._train_step(
+                                params, velocities, self.hypers(), dataset,
+                                targets, put(seg[0]["idx"]),
+                                np.int32(seg[0]["size"]), key)
+                            advance_lr()
+                            result = ("single", metrics)
+                        else:
+                            idx_op = put(np.stack([s["idx"] for s in seg]))
+                            bs_vec, steps = seg_ops()
+                            params, velocities, ms, conf_sum = \
+                                self._train_scan(
+                                    params, velocities,
+                                    put(hypers_rows(len(seg))), dataset,
+                                    targets, idx_op, bs_vec,
+                                    put(gen.jax_base_key()), steps)
+                            result = ("scan", (ms, conf_sum))
+                    if self._tracer.enabled:
+                        self._tracer.add(
+                            "train", f"dispatch:{result[0]}", t_disp,
+                            _time.perf_counter() - t_disp,
+                            {"steps": len(seg), "step0": step0})
                     self.steps_done += len(seg)
                     flush()             # previous segment, AFTER dispatch
                     inflight = (seg, result[0], result[1], t_iter)
@@ -1281,16 +1322,23 @@ class FusedTrainer:
                         epoch_conf = None
                     feed_decision(mb, (loss, n_err, conf))
                     if not bool(decision.gd_skip):
-                        if staging:
-                            params, velocities, _ = self._train_step(
-                                params, velocities, self.hypers(), dseg,
-                                tseg, bs, key)
-                        else:
-                            params, velocities, _ = self._train_step(
-                                params, velocities, self.hypers(),
-                                dataset, targets, idx, bs, key)
+                        with self._telemetry.step_annotation(
+                                self.steps_done):
+                            if staging:
+                                params, velocities, _ = self._train_step(
+                                    params, velocities, self.hypers(),
+                                    dseg, tseg, bs, key)
+                            else:
+                                params, velocities, _ = self._train_step(
+                                    params, velocities, self.hypers(),
+                                    dataset, targets, idx, bs, key)
                         advance_lr()    # adj is gated like the gds
                     self.steps_done += 1
+                    if self._tracer.enabled:
+                        self._tracer.add(
+                            "train", "tail", t_iter,
+                            _time.perf_counter() - t_iter,
+                            {"epoch": int(mb["epoch_number"])})
                     account(1, mb["size"], t_iter, True, kind="tail")
                 else:
                     flush()
@@ -1337,6 +1385,11 @@ class FusedTrainer:
                                    for i in range(len(seg))]
                     for s, m in zip(seg, stacked):
                         feed_decision(s, m)
+                    if self._tracer.enabled:
+                        self._tracer.add("train", "eval", t_iter,
+                                         _time.perf_counter() - t_iter,
+                                         {"steps": len(seg),
+                                          "class": int(mb["class"])})
                     account(len(seg), 0, t_iter, False,
                             kind=f"eval_{len(seg)}")
                 if bool(decision.epoch_ended):

@@ -41,10 +41,13 @@ refusal an out-of-date slave must receive in a format it can read.
 from __future__ import annotations
 
 import pickle
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from znicz_tpu.telemetry.metrics import registered_property
 
 #: v3 metadata-frame magic; a frame without it is legacy (v2) pickle
 MAGIC = b"ZNW3"
@@ -387,24 +390,41 @@ class Codec:
     refused via :meth:`refusal`, plus whatever the owner adds for
     requests that decode but trip its handler).
 
-    Threading: counters are plain ints — confine each instance to one
-    thread (the serving frontend does all socket+codec work on its
-    router thread; the master's REP loop is single-threaded already).
+    Counters live in the process-wide telemetry registry (ISSUE 5) under
+    ``component=<owner>`` and are exported on ``/metrics``; the
+    historical attribute names remain as thin properties (readable AND
+    writable — the master's resume restore writes them back), so every
+    caller and resume snapshot sees exactly the ints it always did.
+    Each metric carries its own lock, so the old one-thread-per-instance
+    confinement is no longer a correctness requirement — it remains the
+    performance discipline (the serving frontend does all socket+codec
+    work on its router thread; the master's REP loop is single-threaded
+    already).
     """
 
-    def __init__(self, compress: Optional[str] = None):
+    #: registry counters every Codec instance holds: name -> HELP text
+    COUNTERS = {
+        "bytes_in": "wire bytes received (all frames)",
+        "bytes_out": "wire bytes sent (all frames)",
+        "messages_in": "messages decoded",
+        "messages_out": "messages encoded",
+        "bad_frames": "undecodable/garbage frames refused",
+        "tensor_bytes_raw_in": "f32-equivalent tensor bytes received",
+        "tensor_bytes_wire_in": "actual tensor bytes received",
+        "tensor_bytes_raw_out": "f32-equivalent tensor bytes sent",
+        "tensor_bytes_wire_out": "actual tensor bytes sent",
+    }
+
+    def __init__(self, compress: Optional[str] = None, owner: str = "wire"):
+        from znicz_tpu import telemetry
+
         #: cold-path per-tensor compression applied by :meth:`encode`
         #: ("none"/""/None = off) — the params-broadcast knob
         self.compress = None if compress in (None, "", "none") else compress
-        self.bytes_in = 0
-        self.bytes_out = 0
-        self.messages_in = 0
-        self.messages_out = 0
-        self.bad_frames = 0
-        self.tensor_bytes_raw_in = 0
-        self.tensor_bytes_wire_in = 0
-        self.tensor_bytes_raw_out = 0
-        self.tensor_bytes_wire_out = 0
+        sc = telemetry.scope(owner)
+        self._m = {name: sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._tracer = telemetry.tracer()
 
     @staticmethod
     def frames_bytes(frames: List) -> int:
@@ -419,12 +439,21 @@ class Codec:
         decides whether that refusal ticks :attr:`bad_frames` (via
         :meth:`refusal`) or is fatal."""
         n = self.frames_bytes(frames)
-        self.bytes_in += n
-        msg, info = decode_message(frames)
+        self._m["bytes_in"].inc(n)
+        if self._tracer.enabled:
+            t0 = time.perf_counter()
+            msg, info = decode_message(frames)
+            self._tracer.add("wire", "decode", t0,
+                             time.perf_counter() - t0,
+                             {"bytes": n, "tensors": info.get("tensors", 0),
+                              "trace_id": msg.get("trace_id")
+                              if isinstance(msg, dict) else None})
+        else:           # disabled hot path: no clock reads at all
+            msg, info = decode_message(frames)
         info["message_bytes"] = n
-        self.messages_in += 1
-        self.tensor_bytes_raw_in += info.get("raw_bytes", 0)
-        self.tensor_bytes_wire_in += info.get("wire_bytes", 0)
+        self._m["messages_in"].inc()
+        self._m["tensor_bytes_raw_in"].inc(info.get("raw_bytes", 0))
+        self._m["tensor_bytes_wire_in"].inc(info.get("wire_bytes", 0))
         return msg, info
 
     def encode(self, msg: Any, legacy: bool = False) -> List[Any]:
@@ -432,22 +461,35 @@ class Codec:
         answers a v2-framed peer in kind: one pickled frame (no tensor
         accounting — the blob is opaque), so even an out-of-date peer
         can read its reply."""
+        t0 = time.perf_counter() if self._tracer.enabled else None
         if legacy:
             frames = [pickle.dumps(msg)]
         else:
             frames, enc = encode_message(msg, compress=self.compress)
-            self.tensor_bytes_raw_out += enc["raw_bytes"]
-            self.tensor_bytes_wire_out += enc["wire_bytes"]
-        self.bytes_out += self.frames_bytes(frames)
-        self.messages_out += 1
+            self._m["tensor_bytes_raw_out"].inc(enc["raw_bytes"])
+            self._m["tensor_bytes_wire_out"].inc(enc["wire_bytes"])
+        n = self.frames_bytes(frames)
+        if t0 is not None:
+            self._tracer.add("wire", "encode", t0,
+                             time.perf_counter() - t0,
+                             {"bytes": n, "legacy": legacy,
+                              "trace_id": msg.get("trace_id")
+                              if isinstance(msg, dict) else None})
+        self._m["bytes_out"].inc(n)
+        self._m["messages_out"].inc()
         return frames
+
+    def count_bad_frame(self) -> None:
+        """Tick ``bad_frames`` for a request that DECODED but tripped the
+        owner's handler (the owner's half of the fault accounting)."""
+        self._m["bad_frames"].inc()
 
     def refusal(self, error: str, legacy: bool = True, **extra) -> List:
         """The counted bad-frame refusal reply: ``bad_frames`` ticks and
         the reply defaults to LEGACY framing — an undecodable request's
         peer format is unknown, and a single pickle is the one framing
         every protocol revision can read."""
-        self.bad_frames += 1
+        self._m["bad_frames"].inc()
         return self.encode({"ok": False, "bad_frame": True,
                             "error": error, **extra}, legacy=legacy)
 
@@ -464,6 +506,11 @@ class Codec:
         if not cooked:
             return None
         return raw / cooked
+
+
+for _name, _help in Codec.COUNTERS.items():
+    setattr(Codec, _name, registered_property(_name, _help))
+del _name, _help
 
 
 def split_envelope(frames: List[bytes]

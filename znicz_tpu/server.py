@@ -36,6 +36,10 @@ import numpy as np
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
+# the shared ISSUE-5 compat layer: registry counters readable/writable
+# under their historical attribute names (web_status, resume snapshots)
+from znicz_tpu.telemetry.metrics import registered_property as \
+    _server_counter
 
 
 def _codec_counter(name: str, doc: str) -> property:
@@ -49,6 +53,7 @@ def _codec_counter(name: str, doc: str) -> property:
         setattr(self.codec, name, value)
 
     return property(fget, fset, doc=doc)
+
 
 
 class Server:
@@ -94,13 +99,23 @@ class Server:
         self.registered: set = set()                # handshake-passed ids
         self.dead_slaves: Dict[str, float] = {}     # evicted id -> last seen
         self._ever_registered: set = set()
-        self.jobs_done = 0
-        self.jobs_requeued = 0
-        self.stale_updates = 0
-        self.bad_updates = 0            # malformed replies refused+requeued
-        self.quarantined_updates = 0    # non-finite / norm-exploded deltas
-        self.reregistrations = 0        # re-registers (slave reconnects)
-        self.resume_saves = 0           # crash-resume snapshots written
+        # -- telemetry (ISSUE 5): every master counter lives in the
+        # process-wide registry (exported on /metrics) under
+        # component="master"; the class-level _server_counter properties
+        # keep the historical attribute names readable/writable for
+        # web_status, resume snapshots and tests
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("master")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._tracer = telemetry.tracer()
+        import uuid
+
+        #: per-Server tag prefixing job trace_ids, so two masters'
+        #: (or a restarted master's) trace_ids never collide when
+        #: traces are merged across processes
+        self._run_tag = uuid.uuid4().hex[:6]
         #: cold-path compression of the params broadcast ("none"/"zlib"/
         #: "lz4"); deltas are quantized by the CLIENT (engine.wire_dtype)
         self.wire_compress = str(
@@ -112,10 +127,8 @@ class Server:
         # (web_status, resume snapshots, tests)
         from znicz_tpu.parallel import wire
 
-        self.codec = wire.Codec(compress=self.wire_compress)
-        self.updates_received = 0       # update messages seen (any outcome)
-        self.update_bytes_in = 0        # wire bytes of those updates
-        self.prefetch_hit = 0           # jobs served to prefetch requests
+        self.codec = wire.Codec(compress=self.wire_compress,
+                                owner="master")
         self.jobs_by_slave: Dict[str, int] = {}
         self._pending: List[dict] = []              # re-queued lost jobs
         self._inflight: Dict[int, tuple] = {}       # job_id -> (job, t, sid)
@@ -170,6 +183,26 @@ class Server:
                 if k in d:
                     mem = arr.map_write()
                     mem += d[k]
+
+    # -- counters (one home: the telemetry registry) ---------------------------
+
+    #: master counters registered under component="master" (ISSUE 5):
+    #: name -> HELP text (also each property's docstring)
+    COUNTERS = {
+        "jobs_done": "jobs completed",   # shared family w/ slave
+        "jobs_requeued": "lost/refused jobs re-queued",
+        "stale_updates": "updates dropped: job already reaped/finished",
+        "bad_updates": "malformed replies refused+requeued",
+        "quarantined_updates": "non-finite / norm-exploded deltas refused",
+        "reregistrations": "re-registers (slave reconnects)",
+        "resume_saves": "crash-resume snapshots written",
+        "updates_received": "update messages seen (any outcome)",
+        "update_bytes_in": "wire bytes of update messages",
+        "prefetch_hit": "jobs served to prefetch requests",
+    }
+
+    # (the historical attribute properties are generated from COUNTERS
+    # right after the class body — one source of truth per counter)
 
     # -- wire accounting (one home: the Codec) ---------------------------------
 
@@ -231,7 +264,7 @@ class Server:
         for jid in lost:
             job, _, sid = self._inflight.pop(jid)
             self._pending.append(job)
-            self.jobs_requeued += 1
+            self._m["jobs_requeued"].inc()
 
     def _evict_dead_slaves(self) -> None:
         """Membership hygiene: a slave silent past ``slave_ttl`` is moved
@@ -376,7 +409,7 @@ class Server:
         the epoch cannot close without its feed."""
         import logging
 
-        setattr(self, counter, getattr(self, counter) + 1)
+        self._m[counter].inc()
         job["_bad_replies"] = job.get("_bad_replies", 0) + 1
         requeue = (bool(job.get("last_minibatch"))
                    or job["_bad_replies"] < self.MAX_BAD_REPLIES)
@@ -461,7 +494,7 @@ class Server:
         # be unreadable at restart — the one moment it must not be
         snapshotter.write_host_pickle(
             path, snap, "gz" if path.endswith(".gz") else "none")
-        self.resume_saves += 1
+        self._m["resume_saves"].inc()
 
     def restore_resume(self, path: str) -> None:
         """Restore from a ``save_resume`` file onto the (initialized)
@@ -612,12 +645,19 @@ class Server:
             return rep_frames
         legacy = bool(info.get("legacy"))
         if req.get("cmd") == "update":
-            self.updates_received += 1
-            self.update_bytes_in += info["message_bytes"]
+            self._m["updates_received"].inc()
+            self._m["update_bytes_in"].inc(info["message_bytes"])
         try:
-            rep = self._handle(req)
+            # span around REP handling, correlated by the job's trace_id
+            # (the request echoes the id the job reply carried — ISSUE 5
+            # satellite: wire-v3 metadata carries trace_id end-to-end)
+            with self._tracer.span(
+                    "master", f"handle:{req.get('cmd')}",
+                    job_id=req.get("job_id"),
+                    trace_id=req.get("trace_id"), slave=req.get("id")):
+                rep = self._handle(req)
         except Exception as exc:
-            self.bad_frames += 1
+            self.codec.count_bad_frame()
             logging.getLogger("znicz").exception(
                 "refused malformed request %r", req.get("cmd"))
             rep = {"ok": False, "bad_frame": True,
@@ -643,7 +683,7 @@ class Server:
                 # a repeat register = a slave reconnect (backoff retry or
                 # a peer re-joining a crash-resumed master, whose job
                 # history came back with the snapshot)
-                self.reregistrations += 1
+                self._m["reregistrations"].inc()
             self._ever_registered.add(sid)
             self.registered.add(sid)
             self.slaves[sid] = time.time()
@@ -672,8 +712,13 @@ class Server:
             if req.get("prefetch"):
                 # the client's pipeline socket asked for this job ahead
                 # of need — the fetch overlapped compute (ISSUE 3)
-                self.prefetch_hit += 1
+                self._m["prefetch_hit"].inc()
+            # trace_id: the cross-process correlation key (ISSUE 5).  It
+            # rides the v3 metadata frame as an OPTIONAL dict key — the
+            # slave echoes it in the update, spans on both sides carry
+            # it, and an old peer that ignores it still works.
             return {"job_id": jid, "job": job,
+                    "trace_id": f"{self._run_tag}-{jid}",
                     "params": self.snapshot_params(),
                     "train": job["class"] == TRAIN}
         if cmd == "update":
@@ -683,7 +728,7 @@ class Server:
                 # job already reaped/re-queued (slow slave) or finished —
                 # the update must be DROPPED, not applied (async staleness
                 # bound: one job, one accepted update)
-                self.stale_updates += 1
+                self._m["stale_updates"].inc()
                 return {"ok": False, "stale": True}
             job, t_issued, _ = entry
             # round-trip duration of a slave that DID answer — feeds the
@@ -742,7 +787,14 @@ class Server:
                     # type guard (None is legal) but must not reach
                     # _feed_decision's .get calls
                     self._feed_decision(job, req.get("metrics") or {})
-            self.jobs_done += 1
+            self._m["jobs_done"].inc()
             self.jobs_by_slave[sid] = self.jobs_by_slave.get(sid, 0) + 1
             return {"ok": True, "complete": bool(self.decision.complete)}
         return {"error": f"unknown cmd {cmd!r}"}
+
+
+# historical counter attributes, generated from COUNTERS (name + HELP
+# defined exactly once; read/write for resume restore)
+for _name, _help in Server.COUNTERS.items():
+    setattr(Server, _name, _server_counter(_name, _help))
+del _name, _help

@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from znicz_tpu.telemetry.metrics import registered_property
+
 
 class BucketLadder:
     """The fixed ladder of padded batch sizes.  Default rungs are the
@@ -79,13 +81,17 @@ class Request:
     answer (the ROUTER envelope), ``req_id`` the client's correlation
     id.  ``t_enqueued`` feeds the latency stats and the TTL check."""
 
-    __slots__ = ("x", "n", "reply_to", "req_id", "t_enqueued")
+    __slots__ = ("x", "n", "reply_to", "req_id", "trace_id", "t_enqueued")
 
-    def __init__(self, x, n: int, reply_to=None, req_id=None):
+    def __init__(self, x, n: int, reply_to=None, req_id=None,
+                 trace_id=None):
         self.x = x
         self.n = int(n)
         self.reply_to = reply_to
         self.req_id = req_id
+        #: optional cross-process correlation id carried in the wire-v3
+        #: metadata (ISSUE 5) — echoed in the reply, tagged on spans
+        self.trace_id = trace_id
         self.t_enqueued = time.perf_counter()
 
 
@@ -97,9 +103,23 @@ class DynamicBatcher:
     client sees WHY it was refused instead of timing out.
     """
 
+    #: batcher counters registered under component="batcher" (ISSUE 5):
+    #: name -> HELP text
+    COUNTERS = {
+        "submitted": "accepted requests",
+        "shed": "refused: queue at bound",
+        "oversized": "refused: n > max_batch",
+        "batches": "batches closed",
+        "batched_requests": "requests inside closed batches",
+        "batched_rows": "real rows inside closed batches",
+        "padded_rows": "pad rows added by the ladder",
+    }
+
     def __init__(self, max_batch: int = 32, max_delay_ms: float = 5.0,
                  queue_bound: int = 256,
                  ladder: Optional[BucketLadder] = None):
+        from znicz_tpu import telemetry
+
         self.ladder = ladder or BucketLadder(max_batch)
         self.max_batch = self.ladder.max_batch
         self.max_delay_s = float(max_delay_ms) / 1e3
@@ -108,33 +128,45 @@ class DynamicBatcher:
         self._rows = 0                      # rows currently queued
         self._cond = threading.Condition()
         self._closed = False
-        # -- accounting (the serving panel's inputs) -----------------------
-        self.submitted = 0                  # accepted requests
-        self.shed = 0                       # refused: queue at bound
-        self.oversized = 0                  # refused: n > max_batch
-        self.batches = 0                    # batches closed
-        self.batched_requests = 0           # requests inside those batches
-        self.batched_rows = 0               # real rows inside those batches
-        self.padded_rows = 0                # pad rows added by the ladder
-        self.bucket_hits: Dict[int, int] = {r: 0 for r in self.ladder}
+        # -- accounting (the serving panel's inputs), homed in the
+        # telemetry registry; historical attribute names preserved by
+        # the class-level properties below
+        _sc = telemetry.scope("batcher")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._m_bucket_hits = {
+            r: _sc.counter("bucket_hits", "batches closed per ladder rung",
+                           bucket=str(r))
+            for r in self.ladder}
+        _sc.gauge("queue_depth", "rows queued, not yet batched",
+                  fn=telemetry.weak_fn(self, lambda b: b._rows))
+
+    # -- registry-backed counters under their historical names ------------
+    # (properties generated from COUNTERS after the class body)
+
+    @property
+    def bucket_hits(self) -> Dict[int, int]:
+        """``{rung: batches closed at that rung}`` snapshot (historical
+        read shape; the counters live in the registry)."""
+        return {r: c.value for r, c in self._m_bucket_hits.items()}
 
     # -- producer side ---------------------------------------------------------
 
     def submit(self, req: Request) -> Optional[str]:
         if req.n < 1 or req.n > self.max_batch:
-            self.oversized += 1
+            self._m["oversized"].inc()
             return (f"request of {req.n} rows exceeds max_batch="
                     f"{self.max_batch} (split it client-side)")
         with self._cond:
             if self._closed:
                 return "service is shutting down"
             if self._rows + req.n > self.queue_bound:
-                self.shed += 1
+                self._m["shed"].inc()
                 return (f"queue at bound ({self._rows} rows queued, "
                         f"bound {self.queue_bound}) — shed")
             self._q.append(req)
             self._rows += req.n
-            self.submitted += 1
+            self._m["submitted"].inc()
             self._cond.notify()
             return None
 
@@ -194,11 +226,11 @@ class DynamicBatcher:
                     break
                 self._cond.wait(remaining)
         bucket = self.ladder.bucket_for(rows)
-        self.batches += 1
-        self.batched_requests += len(batch)
-        self.batched_rows += rows
-        self.padded_rows += bucket - rows
-        self.bucket_hits[bucket] += 1
+        self._m["batches"].inc()
+        self._m["batched_requests"].inc(len(batch))
+        self._m["batched_rows"].inc(rows)
+        self._m["padded_rows"].inc(bucket - rows)
+        self._m_bucket_hits[bucket].inc()
         return batch
 
     # -- stats -----------------------------------------------------------------
@@ -227,3 +259,10 @@ class DynamicBatcher:
             "mean_occupancy": None if occ is None else round(occ, 4),
             "bucket_hits": dict(self.bucket_hits),
         }
+
+
+# historical counter attributes, generated from COUNTERS (name + HELP
+# defined exactly once)
+for _name, _help in DynamicBatcher.COUNTERS.items():
+    setattr(DynamicBatcher, _name, registered_property(_name, _help))
+del _name, _help

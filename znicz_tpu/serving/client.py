@@ -20,6 +20,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from znicz_tpu.telemetry.metrics import registered_property
+
 
 class InferenceError(RuntimeError):
     """The service answered, but with a refusal (bad frame / shed /
@@ -38,15 +40,24 @@ class InferenceClient:
 
     def __init__(self, endpoint: str, timeout: float = 10.0,
                  resend_after_s: float = 1.0, max_resends: int = 8):
+        import uuid
+
         import zmq
 
+        #: prefix for this client's trace_ids (ISSUE 5 correlation —
+        #: the server echoes them in replies and tags its spans)
+        self._tag = uuid.uuid4().hex[:6]
         self.endpoint = endpoint
         self.timeout = float(timeout)
         self.resend_after_s = float(resend_after_s)
         self.max_resends = int(max_resends)
-        self.resends = 0                # re-sent requests (lost/ignored)
-        self.bad_replies = 0            # undecodable reply stacks
-        self.errors = 0                 # service refusals received
+        # telemetry (ISSUE 5): client-side accounting in the registry;
+        # historical attribute names preserved by generated properties
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("serving_client")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
         self._ids = itertools.count(1)
         #: req_id -> [frames, t_last_sent, resends]
         self._pending: Dict[int, List] = {}
@@ -55,6 +66,15 @@ class InferenceClient:
         self._sock = self._ctx.socket(zmq.DEALER)
         self._sock.setsockopt(zmq.LINGER, 0)
         self._sock.connect(endpoint)
+
+    #: client counters registered under component="serving_client"
+    #: (ISSUE 5): name -> HELP text; properties generated after the
+    #: class body
+    COUNTERS = {
+        "resends": "re-sent requests (lost/ignored)",
+        "bad_replies": "undecodable replies",  # shared family
+        "errors": "service refusals received",
+    }
 
     # -- pipelined API ---------------------------------------------------------
 
@@ -69,6 +89,9 @@ class InferenceClient:
 
         rid = next(self._ids)
         msg["req_id"] = rid
+        # optional correlation key in the v3 metadata frame (ISSUE 5):
+        # old servers ignore it, new ones echo it and tag their spans
+        msg.setdefault("trace_id", f"{self._tag}-{rid}")
         payload, _ = wire.encode_message(msg)
         frames = [b""] + payload
         self._sock.send_multipart(frames, copy=False)
@@ -113,7 +136,7 @@ class InferenceClient:
                     raise wire.WireError(
                         f"reply decodes to {type(rep).__name__}")
             except Exception:
-                self.bad_replies += 1
+                self._m["bad_replies"].inc()
                 continue
             rid = rep.get("req_id")
             if rid in self._pending:
@@ -136,7 +159,7 @@ class InferenceClient:
             self._sock.send_multipart(frames, copy=False)
             entry[1] = now
             entry[2] = n + 1
-            self.resends += 1
+            self._m["resends"].inc()
 
     def result(self, req_id: int, timeout: Optional[float] = None) -> dict:
         """Block until ``req_id``'s reply lands (resending past the
@@ -152,7 +175,7 @@ class InferenceClient:
             self._maybe_resend()
         rep = self._results.pop(req_id)
         if not rep.get("ok"):
-            self.errors += 1
+            self._m["errors"].inc()
             raise InferenceError(rep)
         return rep
 
@@ -180,3 +203,8 @@ class InferenceClient:
 
     def close(self) -> None:
         self._sock.close(0)
+
+
+for _name, _help in InferenceClient.COUNTERS.items():
+    setattr(InferenceClient, _name, registered_property(_name, _help))
+del _name, _help

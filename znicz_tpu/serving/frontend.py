@@ -43,6 +43,8 @@ import numpy as np
 
 from znicz_tpu.core.config import root
 
+from znicz_tpu.telemetry.metrics import registered_property
+
 from .batcher import BucketLadder, DynamicBatcher, Request
 from .model import ModelRunner
 
@@ -89,14 +91,20 @@ class InferenceServer:
         self.request_ttl_s = float(_cfg("request_ttl_s", request_ttl_s))
         self.max_requests = max_requests
         self._warmup = warmup
-        self.codec = wire.Codec()           # router-thread only
-        self.requests_in = 0                # decoded infer requests
-        self.served = 0                     # answered with a result
-        self.timed_out = 0                  # answered timed_out (TTL)
-        self.rejected = 0                   # answered shed/oversized
+        self.codec = wire.Codec(owner="serving")    # router-thread only
+        # -- telemetry (ISSUE 5): serving counters + the request-latency
+        # ring histogram live in the registry (component="serving");
+        # the class-level properties preserve the historical names
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("serving")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._m_latency = _sc.histogram(
+            "request_latency_seconds",
+            "e2e request latency (enqueue -> reply handoff)", size=8192)
+        self._tracer = telemetry.tracer()
         self.started_at: Optional[float] = None
-        self._latencies: List[float] = []   # seconds, capped window
-        self._lat_cap = 8192
         self._outbound: "queue.Queue" = queue.Queue()
         self._wake_addr: Optional[str] = None    # set at serve() bind
         self._stop = threading.Event()
@@ -107,6 +115,18 @@ class InferenceServer:
         self.log = logging.getLogger("znicz.serving")
 
     # -- counters shorthand ----------------------------------------------------
+
+    #: serving counters registered under component="serving" (ISSUE 5):
+    #: name -> HELP text
+    COUNTERS = {
+        "requests_in": "decoded infer requests",
+        "served": "answered with a result",
+        "timed_out": "answered timed_out (TTL)",
+        "rejected": "answered shed/oversized",
+    }
+
+    # (the historical attribute properties are generated from COUNTERS
+    # right after the class body)
 
     @property
     def bad_frames(self) -> int:
@@ -119,10 +139,10 @@ class InferenceServer:
                                  1e-9)
 
     def latency_quantiles(self) -> Dict[str, Optional[float]]:
-        lat = self._latencies[-self._lat_cap:]
-        if not lat:
+        lat = self._m_latency.window()      # the last <=8192 requests
+        if lat.size == 0:
             return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
-        a = np.asarray(lat) * 1e3
+        a = lat * 1e3
         return {"p50_ms": round(float(np.percentile(a, 50)), 3),
                 "p99_ms": round(float(np.percentile(a, 99)), 3),
                 "mean_ms": round(float(np.mean(a)), 3)}
@@ -245,20 +265,23 @@ class InferenceServer:
             wake_r.close(0)
 
     def _drain_outbound(self, sock) -> None:
+        n = 0
+        t0 = time.perf_counter()
         while True:
             try:
                 envelope, rep, t_enqueued = self._outbound.get_nowait()
             except queue.Empty:
-                return
+                break
             if t_enqueued is not None:
-                lat = time.perf_counter() - t_enqueued
-                self._latencies.append(lat)
-                if len(self._latencies) > 2 * self._lat_cap:
-                    del self._latencies[:self._lat_cap]
+                self._m_latency.observe(time.perf_counter() - t_enqueued)
             # copy=False: result frames are memoryviews of arrays owned
             # by the reply dicts, never mutated after encode
             sock.send_multipart(
                 list(envelope) + self.codec.encode(rep), copy=False)
+            n += 1
+        if n and self._tracer.enabled:
+            self._tracer.add("serving", "reply", t0,
+                             time.perf_counter() - t0, {"replies": n})
 
     def _handle(self, sock, frames: List[bytes]) -> None:
         from znicz_tpu.parallel import wire
@@ -324,14 +347,15 @@ class InferenceServer:
                           f"to the model's storage dtype "
                           f"{self.runner.dtype}"}))
             return
-        self.requests_in += 1
+        self._m["requests_in"].inc()
         reason = self.batcher.submit(
-            Request(x, x.shape[0], reply_to=list(envelope), req_id=rid))
+            Request(x, x.shape[0], reply_to=list(envelope), req_id=rid,
+                    trace_id=req.get("trace_id")))
         if reason is not None:
-            self.rejected += 1
+            self._m["rejected"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "rejected": True, "req_id": rid,
-                 "error": reason}))
+                 "trace_id": req.get("trace_id"), "error": reason}))
 
     # -- the compute loop (donated ping-pong) ----------------------------------
 
@@ -344,9 +368,10 @@ class InferenceServer:
         live = []
         for r in batch:
             if now - r.t_enqueued > self.request_ttl_s:
-                self.timed_out += 1
+                self._m["timed_out"].inc()
                 self._outbound.put((r.reply_to, {
                     "ok": False, "timed_out": True, "req_id": r.req_id,
+                    "trace_id": r.trace_id,
                     "error": f"request waited past request_ttl_s="
                              f"{self.request_ttl_s:g}"}, None))
                 continue
@@ -355,26 +380,38 @@ class InferenceServer:
             return None
         rows = sum(r.n for r in live)
         bucket = self.batcher.ladder.bucket_for(rows)
-        x = np.zeros((bucket,) + self.runner.sample_shape,
-                     self.runner.dtype)
-        off = 0
-        for r in live:
-            x[off:off + r.n] = np.asarray(r.x, self.runner.dtype) \
-                .reshape((r.n,) + self.runner.sample_shape)
-            off += r.n
-        return live, self.runner.stage(x)
+        with self._tracer.span("serving", "assemble", rows=rows,
+                               bucket=bucket, requests=len(live)):
+            x = np.zeros((bucket,) + self.runner.sample_shape,
+                         self.runner.dtype)
+            off = 0
+            for r in live:
+                x[off:off + r.n] = np.asarray(r.x, self.runner.dtype) \
+                    .reshape((r.n,) + self.runner.sample_shape)
+                off += r.n
+            staged = self.runner.stage(x)
+        return live, staged
 
-    def _finish(self, live: List[Request], y_dev) -> None:
+    def _finish(self, live: List[Request], y_dev,
+                t_dispatch: Optional[float] = None) -> None:
         y = np.asarray(y_dev)               # the sync point
+        if t_dispatch is not None and self._tracer.enabled:
+            # dispatch -> materialized: the batch's device-compute span
+            # (staging of batch N+1 overlaps inside it by design)
+            self._tracer.add(
+                "serving", "batch_compute", t_dispatch,
+                time.perf_counter() - t_dispatch,
+                {"rows": sum(r.n for r in live), "requests": len(live),
+                 "trace_id": live[0].trace_id if live else None})
         off = 0
         for r in live:
             # slice-copy: each reply owns its rows (the padded tail is
             # dropped here — pad rows never leave the server)
             self._outbound.put((r.reply_to, {
-                "ok": True, "req_id": r.req_id,
+                "ok": True, "req_id": r.req_id, "trace_id": r.trace_id,
                 "y": np.array(y[off:off + r.n])}, r.t_enqueued))
             off += r.n
-            self.served += 1
+            self._m["served"].inc()
 
     def _compute_loop(self) -> None:
         import zmq
@@ -405,6 +442,7 @@ class InferenceServer:
                 live, x_dev = staged
                 # dispatch is async; the staged buffer is DONATED into
                 # the step (ping-pong half 1)
+                t_dispatch = time.perf_counter()
                 y_dev = self.runner.infer_staged(x_dev)
                 staged = None
                 # while the device computes batch N, grab-and-stage what
@@ -416,7 +454,7 @@ class InferenceServer:
                                               wait_fill=False)
                 if nxt is not None:
                     staged = self._assemble(nxt)
-                self._finish(live, y_dev)
+                self._finish(live, y_dev, t_dispatch)
                 poke()                  # replies queued: wake the router
         except Exception:
             # a compute-thread death must not strand clients silently
@@ -425,3 +463,8 @@ class InferenceServer:
             self.batcher.close()
         finally:
             wake.close(0)
+
+
+for _name, _help in InferenceServer.COUNTERS.items():
+    setattr(InferenceServer, _name, registered_property(_name, _help))
+del _name, _help

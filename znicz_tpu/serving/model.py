@@ -34,6 +34,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from znicz_tpu.telemetry.metrics import registered_property
+
 
 class ModelRunner:
     """Freeze a built+initialized workflow's params into a jitted
@@ -73,18 +75,35 @@ class ModelRunner:
         #: the in-graph decode (trainer._decode) widens on device
         self.dtype = np.dtype(mem.dtype) if mem is not None \
             else np.dtype(np.float32)
-        self.compiles = 0               # traces of _fwd == cache entries
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("model")
+        #: traces of _fwd == cache entries (registry counter; the
+        #: ``compiles`` property preserves the historical name)
+        self._m = {"compiles": _sc.counter(
+            "compiles",
+            "traces of the jitted forward == jit cache entries")}
+        compiles = self._m["compiles"]
         key = self._trainer._key0       # eval path never consumes it
 
         def fwd(params, x):
             # trace-time tick: Python runs this body once per compile
             # (cache hits replay the compiled executable only)
-            self.compiles += 1
+            compiles.inc()
             t = self._trainer
             return t.forward_pass(params, t._decode(x), key, train=False)
 
         self._fwd = jax.jit(fwd, donate_argnums=(1,) if self.donate
                             else ())
+        # weak_fn: the process-global registry must not pin this
+        # runner's jitted executables + device params after the service
+        # drops it (a dead ref renders NaN)
+        _sc.gauge("jit_cache_size", "jax's own executable-cache entries",
+                  fn=telemetry.weak_fn(
+                      self, lambda r: r.jit_cache_size()))
+
+    compiles = registered_property(
+        "compiles", "traces of the jitted forward == jit cache entries")
 
     # -- the two halves of the ping-pong ---------------------------------------
 

@@ -27,6 +27,7 @@ import numpy as np
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.units import Unit
+from znicz_tpu.telemetry import metrics as telemetry_metrics
 
 
 def collect(workflow, device_arrays: bool = False) -> Dict:
@@ -206,8 +207,13 @@ class Snapshotter(Unit):
         self._async_pending = None       # queued (snap, tags) jobs (list)
         self._async_lock = None
         self._async_error = None
-        self.async_saves_written = 0     # files written by the worker
-        self.async_saves_coalesced = 0   # superseded queued jobs dropped
+        # telemetry (ISSUE 5): writer counters in the registry under
+        # component="snapshotter"; historical names via the properties
+        from znicz_tpu import telemetry
+
+        _sc = telemetry.scope("snapshotter")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
         self.prefix = kwargs.get("prefix", "wf")
         self.directory = kwargs.get(
             "directory", root.common.dirs.get("snapshots", "snapshots"))
@@ -240,6 +246,14 @@ class Snapshotter(Unit):
         self.improved = False                             # link from decision
         self.epoch_number = 0                             # link from decision
         self._last_saved_epoch = -1
+
+    #: writer counters registered under component="snapshotter"
+    #: (ISSUE 5): name -> HELP text; properties generated after the
+    #: class body
+    COUNTERS = {
+        "async_saves_written": "files written by the async worker",
+        "async_saves_coalesced": "superseded queued jobs dropped",
+    }
 
     def snapshot_path(self, tag: str) -> str:
         if self.format == "orbax":
@@ -389,7 +403,8 @@ class Snapshotter(Unit):
                 kept = []
                 for snap_p, tags_p in self._async_pending:
                     rem = [t for t in tags_p if t != "best"]
-                    self.async_saves_coalesced += len(tags_p) - len(rem)
+                    self._m["async_saves_coalesced"].inc(
+                        len(tags_p) - len(rem))
                     if rem:
                         kept.append((snap_p, rem))
                 self._async_pending = kept
@@ -409,19 +424,22 @@ class Snapshotter(Unit):
                 snap, tags = self._async_pending.pop(0)
                 self._async_busy = True
             try:
+                from znicz_tpu import telemetry
+
                 # the device->host pull happens HERE, off the training
                 # thread; np.asarray on a (replicated) jax array is the
                 # same transfer collect()'s map_read would have paid
-                for group in ("units", "velocities"):
-                    for leaves in snap.get(group, {}).values():
-                        for k, a in leaves.items():
-                            leaves[k] = np.asarray(a)
+                with telemetry.span("snapshot", "pull", tags=list(tags)):
+                    for group in ("units", "velocities"):
+                        for leaves in snap.get(group, {}).values():
+                            for k, a in leaves.items():
+                                leaves[k] = np.asarray(a)
                 os.makedirs(self.directory, exist_ok=True)
                 for tag in tags:
                     path = self.snapshot_path(tag)
                     self._write_host_format(path, snap)
                     self.destination = path
-                    self.async_saves_written += 1
+                    self._m["async_saves_written"].inc()
                     self.info("snapshot (async) -> %s", path)
             except BaseException as exc:   # surfaced on flush/next save
                 self._async_error = exc
@@ -448,18 +466,31 @@ class Snapshotter(Unit):
         write_host_pickle(path, snap, self.compression)
 
 
+for _name, _help in Snapshotter.COUNTERS.items():
+    setattr(Snapshotter, _name, telemetry_metrics.registered_property(
+        _name, _help))
+del _name, _help
+
+
 def write_host_pickle(path: str, snap: Dict, compression: str = "gz") -> None:
     """Atomic (temp file + rename) host-format snapshot write, shared by
     the Snapshotter and the master's crash-resume file (server.py): a
     crash — or the daemon writer dying with the process — mid-dump must
     never truncate the previous good checkpoint; these files exist for
     crash RECOVERY."""
+    from znicz_tpu import telemetry
+
     tmp = path + ".tmp"
     opener = gzip.open if compression == "gz" else open
     try:
-        with opener(tmp, "wb") as f:
-            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
+        # span site (ISSUE 5): every host-format snapshot write — the
+        # Snapshotter's sync and async paths AND the master's
+        # crash-resume file all funnel through here
+        with telemetry.span("snapshot", "write", path=path,
+                            compression=compression):
+            with opener(tmp, "wb") as f:
+                pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)

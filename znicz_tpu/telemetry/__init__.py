@@ -1,0 +1,123 @@
+"""Unified telemetry subsystem (ISSUE 5): ONE process-wide metrics
+registry + trace ring that the master server, slave client, wire codec,
+chaos proxy, batcher, serving frontend, model runner, snapshotter,
+fused trainer, unit engine and decision loop all register into.
+
+Surfaces:
+
+  - ``/metrics`` on web_status: Prometheus text exposition of every
+    registered counter/gauge/histogram (metrics.py);
+  - ``/trace.json`` on web_status: the span ring as Chrome trace-event
+    JSON, loadable in Perfetto (trace.py);
+  - ``--profile-dir`` on the launcher: programmatic
+    ``jax.profiler.start_trace``/``stop_trace`` capture with
+    ``StepTraceAnnotation`` wrapped around each fused train step
+    (:func:`step_annotation`);
+  - ``bench.py --telemetry``: the <2% hot-loop overhead gate.
+
+``set_enabled(False)`` turns the OPTIONAL layer off: spans stop
+recording and the trainer's step histogram stops observing.  Service
+ACCOUNTING counters (bytes, jobs, refusals — state other subsystems
+and dashboards depend on) always run; they predate this module and are
+not "telemetry overhead".
+"""
+
+from __future__ import annotations
+
+import threading
+
+from znicz_tpu.core.config import root
+
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, Scope, registered_property,
+                      weak_fn)
+from .trace import NULL_SPAN, TraceRing  # noqa: F401
+
+_REGISTRY = MetricsRegistry()
+_TRACER = None
+_TRACER_LOCK = threading.Lock()
+_PROFILE_STEPS = False
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (the ``/metrics`` exposition source)."""
+    return _REGISTRY
+
+
+def tracer() -> TraceRing:
+    """The process-wide span ring (the ``/trace.json`` source).
+
+    Created LAZILY on first use, so ``root.common.telemetry
+    .trace_capacity`` / ``.enabled`` set any time before the first
+    telemetry consumer is constructed (launcher overrides, test/config
+    setup) take effect — merely importing a module that imports
+    telemetry does not latch the config.  ``set_enabled`` toggles at
+    runtime; capacity is fixed once the ring exists."""
+    global _TRACER
+    if _TRACER is None:
+        # double-checked under a lock: components construct from
+        # multiple threads (a slave thread's Client racing the main
+        # thread's Server) and each caches the ring it gets — two rings
+        # would leave one component deaf to set_enabled and its spans
+        # missing from /trace.json for the process lifetime
+        with _TRACER_LOCK:
+            if _TRACER is None:
+                _TRACER = TraceRing(
+                    capacity=int(root.common.telemetry.get(
+                        "trace_capacity", 16384)),
+                    enabled=bool(root.common.telemetry.get("enabled",
+                                                           True)))
+    return _TRACER
+
+
+def scope(component: str, **labels) -> Scope:
+    """``registry().scope(...)`` shorthand — what components call in
+    their constructors."""
+    return _REGISTRY.scope(component, **labels)
+
+
+def span(cat: str, name: str, **args):
+    """``tracer().span(...)`` shorthand (no-op context when disabled)."""
+    return tracer().span(cat, name, **args)
+
+
+def enabled() -> bool:
+    return tracer().enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Toggle the optional layer (spans + hot-loop histograms) at
+    runtime — the bench's interleaved on/off overhead protocol."""
+    tracer().enabled = bool(on)
+
+
+def render_prometheus() -> str:
+    return _REGISTRY.render_prometheus()
+
+
+def chrome_trace() -> dict:
+    return tracer().chrome_trace()
+
+
+def set_profile_steps(on: bool) -> None:
+    """Arm :func:`step_annotation` (the launcher's ``--profile-dir``
+    does this so fused train steps land as named steps in the jax
+    profiler timeline)."""
+    global _PROFILE_STEPS
+    _PROFILE_STEPS = bool(on)
+
+
+def profile_steps() -> bool:
+    return _PROFILE_STEPS or bool(
+        root.common.telemetry.get("profile_steps", False))
+
+
+def step_annotation(step: int, name: str = "train_step"):
+    """``jax.profiler.StepTraceAnnotation`` around one train step when
+    step-profiling is armed; a shared no-op context otherwise (jax is
+    not even imported on the cold path)."""
+    if not profile_steps():
+        return NULL_SPAN
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=int(step))

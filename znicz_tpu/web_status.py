@@ -10,7 +10,17 @@ and the device mesh — over a tiny stdlib ThreadingHTTPServer:
     ... train ...
     status.stop()
 
-Endpoints: ``/`` (HTML page, auto-refresh) and ``/status.json``.
+Endpoints: ``/`` (HTML page, auto-refresh), ``/status.json``,
+``/metrics`` (Prometheus text exposition of the process-wide telemetry
+registry — ISSUE 5) and ``/trace.json`` (the telemetry span ring as
+Chrome trace-event JSON; open it in Perfetto).
+
+Lock discipline (ISSUE 5 de-flake satellite): the ``/metrics`` and
+``/trace.json`` handlers SNAPSHOT the registry/ring into a plain
+string/bytes first and only then touch the socket — no registry or
+metric lock is ever held across a socket write, so a slow or stalled
+scraper cannot stall a training loop that increments counters
+(regression test: tests/test_telemetry.py).
 """
 
 from __future__ import annotations
@@ -60,7 +70,11 @@ class WebStatus:
         except Exception as exc:       # no backend reachable: degrade visibly
             logging.getLogger("web_status").warning(
                 "device enumeration failed: %r", exc)
-            out["devices"] = []
+            # STRUCTURED degradation (ISSUE 5 satellite): a consumer can
+            # tell "no devices enumerable (why)" from "zero devices" —
+            # the bare [] used to swallow the failure reason entirely
+            out["devices"] = {"error": f"{type(exc).__name__}: {exc}",
+                              "devices": []}
         for wf in self.workflows:
             info = {"name": wf.name, "stopped": bool(wf.stopped),
                     "units": [{"name": u.name, "runs": u.run_count}
@@ -146,6 +160,21 @@ class WebStatus:
                 if self.path.startswith("/status.json"):
                     body = json.dumps(status.snapshot()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    # Prometheus text exposition (ISSUE 5).  render
+                    # returns a COMPLETE string — the socket write below
+                    # happens with no registry lock held
+                    from znicz_tpu import telemetry
+
+                    body = telemetry.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/trace.json"):
+                    # Chrome trace-event JSON of the span ring (open in
+                    # Perfetto); same snapshot-then-write discipline
+                    from znicz_tpu import telemetry
+
+                    body = json.dumps(telemetry.chrome_trace()).encode()
+                    ctype = "application/json"
                 else:
                     snap = status.snapshot()
                     rows = "".join(
@@ -213,14 +242,21 @@ class WebStatus:
                             f"{m['jit_cache_size']})</p>"
                             "<table border=1><tr><th>bucket</th>"
                             f"<th>hits</th></tr>{brows}</table>")
+                    devs = snap["devices"]
+                    dev_text = (f"unavailable — {devs['error']}"
+                                if isinstance(devs, dict)
+                                else ", ".join(devs))
                     body = (
                         "<html><head><meta http-equiv='refresh' content='2'>"
                         "<title>znicz-tpu status</title></head><body>"
-                        f"<h2>Devices</h2><p>{html.escape(', '.join(snap['devices']))}</p>"
+                        f"<h2>Devices</h2><p>{html.escape(dev_text)}</p>"
                         "<h2>Workflows</h2><table border=1>"
                         "<tr><th>name</th><th>epoch</th><th>best</th>"
                         f"<th>state</th></tr>{rows}</table>"
                         f"{master_html}{serving_html}"
+                        "<p><a href='/metrics'>/metrics</a> "
+                        "<a href='/trace.json'>/trace.json</a> "
+                        "<a href='/status.json'>/status.json</a></p>"
                         "</body></html>").encode()
                     ctype = "text/html"
                 self.send_response(200)
