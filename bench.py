@@ -66,10 +66,12 @@ alongside MFU (ISSUE 3).
 
 ``python bench.py --serve`` gates the dynamic-batching inference service
 (znicz_tpu/serving/, ISSUE 4) in one JSON line: interleaved sequential-
-batch-1 vs coalesced-saturation throughput (FAILS below 3x), paced-load
-p99 vs 2x(max_delay + in-stream measured batch service time), and a
-zero-recompiles-after-warmup proof over a mixed-size request stream
-(bucket-ladder jit cache).  All gates are relative to same-host,
+batch-1 vs coalesced-saturation throughput (FAILS below 3x, measured
+WITH admission control enabled), paced-load p99 vs 2x(max_delay +
+in-stream measured batch service time), an interleaved admission-on/off
+p50 overhead gate at the same operating point (FAILS above 2% — ISSUE
+6), and a zero-recompiles-after-warmup proof over a mixed-size request
+stream (bucket-ladder jit cache).  All gates are relative to same-host,
 same-phase measurements, so they are TPU-independent.
 
 ``python bench.py --telemetry`` gates the unified telemetry layer
@@ -866,6 +868,16 @@ SERVE_PACED_FRACTION = 0.7  # latency SLO operating point (of capacity;
 SERVE_LATENCY_ROUNDS = 3    # best-of rounds (shared-host load spikes)
 SERVE_THROUGHPUT_FLOOR = 3.0
 SERVE_P99_MULT = 2.0
+#: admission/deadline overhead gate (ISSUE 6): interleaved
+#: admission-ON/OFF paced windows at the same 0.7x operating point,
+#: best-of per variant (telemetry-gate discipline: a cgroup load spike
+#: must hit both variants, and it can only ever slow a window down).
+#: The ON policy is a generous rate limit + fair queueing: the full
+#: token-bucket/DRR/deadline code path runs on every request without
+#: refusing any (refusals would change the measured population).
+SERVE_ADMISSION_S = 2.0     # paced window per variant per round
+SERVE_ADMISSION_ROUNDS = 4  # bounded interleaved pairs, early-exit
+SERVE_ADMISSION_PCT = 2.0   # p50 overhead ceiling, percent
 
 
 def _build_serve_workflow():
@@ -920,7 +932,8 @@ def serve_main() -> None:
     import gc
     import time as _time
 
-    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.serving import (AdmissionPolicy, InferenceClient,
+                                   InferenceServer)
 
     sys.setswitchinterval(1e-3)       # 3 busy threads on a shared core:
     # the default 5ms GIL slice adds multi-ms scheduling jitter straight
@@ -939,13 +952,24 @@ def serve_main() -> None:
     # a quiet-moment baseline against a loaded-moment coalesced run
     # would make the RELATIVE gate noise, not signal; best-of windows
     # per service, since background load only ever slows a window down)
+    # breaker OFF on both bench clients (breaker_failures=0): the
+    # closed-loop phases deliberately overdrive the queue bound, and a
+    # polite client backing off on shed would distort the very offered
+    # load the saturation/shed behavior is measured under
     srv1 = InferenceServer(wf, max_batch=1, max_delay_ms=0.0).start()
-    cli1 = InferenceClient(srv1.endpoint, timeout=120)
+    cli1 = InferenceClient(srv1.endpoint, timeout=120,
+                           breaker_failures=0)
+    # admission control ENABLED for every gated phase (ISSUE 6): the
+    # rate limit is generous so nothing is refused, but every request
+    # pays the token-bucket + fair-queue + deadline bookkeeping — the
+    # coalescing and p99 gates must hold WITH the admission path on
+    adm_on = AdmissionPolicy(rate_limit=1e9, rate_burst=1e9, fair=True)
     srv = InferenceServer(wf, max_batch=SERVE_MAX_BATCH,
                           max_delay_ms=SERVE_MAX_DELAY_MS,
-                          queue_bound=8 * SERVE_MAX_BATCH).start()
+                          queue_bound=8 * SERVE_MAX_BATCH,
+                          admission=adm_on).start()
     compiles_warm = srv.runner.compiles   # every ladder rung compiled
-    cli = InferenceClient(srv.endpoint, timeout=120)
+    cli = InferenceClient(srv.endpoint, timeout=120, breaker_failures=0)
 
     submitted_at = {}
 
@@ -1069,6 +1093,29 @@ def serve_main() -> None:
         gc.enable()
     best = min(rounds, key=lambda r: r["p99_ms"] - r["p99_bound_ms"])
 
+    # ---- phase 3b: admission/deadline overhead (interleaved on/off) ------
+    adm_off = AdmissionPolicy(enabled=False)
+    on_p50: list = []
+    off_p50: list = []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(SERVE_ADMISSION_ROUNDS):
+            for policy, dest in ((adm_off, off_p50), (adm_on, on_p50)):
+                srv.batcher.set_admission(policy)
+                lats, _ = drive_paced(
+                    SERVE_ADMISSION_S,
+                    SERVE_PACED_FRACTION * coalesced_qps)
+                dest.append(float(np.percentile(
+                    np.asarray(lats) * 1e3, 50)))
+            if min(on_p50) <= min(off_p50) * (
+                    1 + SERVE_ADMISSION_PCT / 100):
+                break                     # gate met; stop burning time
+    finally:
+        gc.enable()
+        srv.batcher.set_admission(adm_on)
+    admission_overhead_pct = (min(on_p50) / min(off_p50) - 1.0) * 100
+
     # ---- phase 4: mixed-size stream (bucket-ladder proof) ----------------
     drive_closed(SERVE_MIXED_S,
                  sizes=[1, 2, 3, 5, 8, 13, 21, SERVE_MAX_BATCH, 7, 2, 30])
@@ -1094,6 +1141,14 @@ def serve_main() -> None:
         "paced_fraction": SERVE_PACED_FRACTION,
         "latency": best,
         "latency_rounds": rounds,
+        "admission": {
+            "p50_on_ms": round(min(on_p50), 3),
+            "p50_off_ms": round(min(off_p50), 3),
+            "overhead_pct": round(admission_overhead_pct, 2),
+            "rounds": len(on_p50),
+            "overhead_ceiling_pct": SERVE_ADMISSION_PCT,
+        },
+        "generation": stats["generation"],
         "bucket_hits": stats["batcher"]["bucket_hits"],
         "compiles_after_warmup": compiles_warm,
         "recompiles_mixed_stream": recompiles,
@@ -1116,6 +1171,12 @@ def serve_main() -> None:
     if recompiles:
         failures.append(f"{recompiles} recompiles during the mixed-size "
                         "stream (bucket ladder leak)")
+    if admission_overhead_pct > SERVE_ADMISSION_PCT:
+        failures.append(
+            f"admission/deadline path adds "
+            f"{admission_overhead_pct:.2f}% p50 at the "
+            f"{SERVE_PACED_FRACTION}x operating point "
+            f"(ceiling {SERVE_ADMISSION_PCT}%)")
     if failures:
         raise SystemExit("serving gates failed: " + "; ".join(failures))
 

@@ -70,6 +70,33 @@ def test_fault_schedule_deterministic():
         FaultSchedule(1, drop=0.7, corrupt=0.4)
 
 
+def test_compute_fault_stream_deterministic_and_independent():
+    """The ISSUE 6 ``stall`` kind rides a SEPARATE seeded stream:
+    adding it to a schedule leaves the wire decisions byte-identical
+    (existing chaos runs replay unchanged), and decide_compute is a
+    pure function of (seed, dispatch_no)."""
+    from znicz_tpu.parallel.chaos import FaultSchedule
+
+    a = FaultSchedule(7, drop=0.1, corrupt=0.1, stall=0.5,
+                      stall_s=(0.01, 0.02))
+    b = FaultSchedule(7, drop=0.1, corrupt=0.1)
+    assert a.decisions(300) == b.decisions(300)
+    c = FaultSchedule(7, stall=0.5, stall_s=(0.01, 0.02))
+    assert [a.decide_compute(i) for i in range(200)] \
+        == [c.decide_compute(i) for i in range(200)]
+    kinds = {a.decide_compute(i)[0] for i in range(200)}
+    assert kinds == {"stall", "run"}
+    for act, s in (a.decide_compute(i) for i in range(200)):
+        if act == "run":
+            assert s == 0.0
+        else:
+            assert 0.01 <= s <= 0.02
+    # stall never fires on a stall-free schedule
+    assert all(b.decide_compute(i)[0] == "run" for i in range(100))
+    with pytest.raises(ValueError, match="stall"):
+        FaultSchedule(1, stall=1.5)
+
+
 def test_corrupt_payload_is_undecodable():
     from znicz_tpu.parallel.chaos import corrupt_payload
 

@@ -1,4 +1,5 @@
-"""CI lint (ISSUE 5 satellite): no NEW ad-hoc counter attributes.
+"""CI lints: no NEW ad-hoc counter attributes (ISSUE 5 satellite), and
+no silently-ignored serving config knobs (ISSUE 6 satellite).
 
 PRs 1-4 each grew bespoke ``self.<name> += 1`` counters (``bad_frames``,
 ``prefetch_hits``, ``shed``, ...), readable only through whichever panel
@@ -71,3 +72,96 @@ def test_lint_pattern_catches_the_regression_class():
     assert PATTERN.search("self.retry_count += n")
     assert not PATTERN.search("self._pos += 1")          # cursor, not metric
     assert not PATTERN.search("unit.run_count += 1")     # not self.
+
+
+# -- serving config-knob lint (ISSUE 6 satellite) ------------------------------
+#
+# A ``root.common.serving.*`` read whose key is missing from the serving
+# DEFAULTS table is config the service will silently ignore under the
+# dotted-override CLI (the Config tree autovivifies, so a typo'd or
+# undeclared knob reads as its default forever, no error).  Every key
+# the package reads must be declared in serving/frontend.py DEFAULTS.
+
+SERVING_CFG = re.compile(
+    r"root\.common\.serving\b(?P<chain>(?:\.get\(\s*\"\w+\"|\.\w+)*)")
+
+#: binding a serving config SUBTREE to a variable (``node =
+#: root.common.serving.admission``) hides every ``node.get("key")``
+#: read from the textual lint above — refuse the aliasing itself and
+#: force literal chains at each read site
+SERVING_ALIAS = re.compile(
+    r"(?<![=!<>])=\s*root\.common\.serving(?:\.[A-Za-z_]\w*)*\s*(?:#.*)?$",
+    re.M)
+
+#: extracts the dotted key path from one matched access chain; a bare
+#: ``.get(variable`` contributes nothing (the frontend's _cfg helper is
+#: keyed off DEFAULTS by construction)
+_CHAIN_TOKEN = re.compile(r'\.get\(\s*"(\w+)"|\.(\w+)')
+
+
+def _chain_key(chain: str):
+    tokens = [lit or attr for lit, attr in _CHAIN_TOKEN.findall(chain)
+              if (lit or attr) != "get"]
+    return ".".join(tokens)
+
+
+def _flat_defaults():
+    from znicz_tpu.serving.frontend import DEFAULTS
+
+    def walk(d, prefix=""):
+        out = set()
+        for k, v in d.items():
+            out.add(prefix + k)
+            if isinstance(v, dict):
+                out |= walk(v, prefix + k + ".")
+        return out
+
+    return walk(DEFAULTS)
+
+
+def test_every_serving_config_read_is_declared_in_defaults():
+    declared = _flat_defaults()
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        text = path.read_text()
+        for m in SERVING_CFG.finditer(text):
+            key = _chain_key(m.group("chain"))
+            if key and key not in declared:
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(
+                    f"{rel}:{line}: root.common.serving.{key}")
+        for m in SERVING_ALIAS.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            offenders.append(
+                f"{rel}:{line}: serving config subtree bound to a "
+                f"variable — later .get() reads are invisible to this "
+                f"lint; spell the literal chain at each read site")
+    assert not offenders, (
+        "serving config keys read in code but missing from the serving "
+        "DEFAULTS table (znicz_tpu/serving/frontend.py) — an undeclared "
+        "knob is silently ignored by dotted overrides; declare it (or "
+        "fix the typo):\n  " + "\n  ".join(offenders))
+
+
+def test_serving_config_lint_catches_the_regression_class():
+    """The lint must fire on undeclared keys and stay quiet on
+    declared ones and on the dynamic _cfg read."""
+    m = SERVING_CFG.search('root.common.serving.get("bogus_knob", 1)')
+    assert _chain_key(m.group("chain")) == "bogus_knob"
+    assert "bogus_knob" not in _flat_defaults()
+    m = SERVING_CFG.search(
+        'root.common.serving.admission.get("rate_limit", 0)')
+    assert _chain_key(m.group("chain")) == "admission.rate_limit"
+    assert "admission.rate_limit" in _flat_defaults()
+    assert "max_batch" in _flat_defaults()
+    # the frontend's dynamic read (variable key) contributes no path
+    m = SERVING_CFG.search("root.common.serving.get(name, DEFAULTS[name])")
+    assert _chain_key(m.group("chain")) == ""
+    # aliasing a subtree is itself an offense; a .get READ is not
+    assert SERVING_ALIAS.search("node = root.common.serving.admission")
+    assert SERVING_ALIAS.search("x = root.common.serving  # comment")
+    assert not SERVING_ALIAS.search(
+        'web_port = root.common.serving.get("web_port", None)')
+    assert not SERVING_ALIAS.search(
+        "if x == root.common.serving.admission:")

@@ -1,7 +1,12 @@
 """Dynamic-batching inference serving layer (ISSUE 4): batcher policy
 units, 0-ULP batched-vs-unbatched parity, bucket-ladder jit-cache
 hygiene, the wire Codec extraction, snapshot inference-load, the
-ChaosProxy soak, the web panel, and the --serve CLI."""
+ChaosProxy soak, the web panel, and the --serve CLI.
+
+Overload-safe serving (ISSUE 6): admission control (token-bucket rate
+limits + DRR fair queueing, refusal policies), deadline propagation,
+the client circuit breaker, zero-downtime snapshot rollover with
+/healthz-/readyz, and the chaos stall/flood soaks (slow-marked)."""
 
 import json
 import threading
@@ -105,6 +110,135 @@ def test_batcher_backpressure_sheds_at_bound():
     assert reason is not None and "max_batch" in reason
     assert b.oversized == 1
     assert b.queue_depth == 10
+
+
+# -- admission control (ISSUE 6) ----------------------------------------------
+
+
+def test_token_bucket_and_refusal_objects():
+    from znicz_tpu.serving import Refusal, TokenBucket
+
+    tb = TokenBucket(rate=100.0, burst=10.0)
+    assert tb.try_take(10)                # the whole burst at once
+    assert not tb.try_take(1)             # empty until refill
+    time.sleep(0.06)                      # ~6 tokens refill
+    assert tb.try_take(4)
+    # refund caps at burst: a shed elsewhere must not mint tokens
+    tb.refund(1000)
+    assert tb.tokens == tb.burst and tb.is_full(time.perf_counter())
+    # a Refusal IS the readable reason string, plus the policy slug
+    r = Refusal("rate_limited", "client over its rate limit")
+    assert isinstance(r, str) and "rate limit" in r
+    assert r.policy == "rate_limited"
+    assert str(r) == "client over its rate limit"   # plain str on the wire
+    assert r.scope == "service"           # default; per-client limits
+    assert Refusal("shed", "x", scope="client").scope == "client"
+
+
+def _creq(n, client):
+    from znicz_tpu.serving import Request
+
+    return Request(np.zeros((n, 4), np.float32), n, req_id=n,
+                   client=client)
+
+
+def test_batcher_rate_limit_per_client():
+    from znicz_tpu.serving import AdmissionPolicy, DynamicBatcher
+
+    b = DynamicBatcher(max_batch=4, max_delay_ms=1.0, queue_bound=100,
+                       admission=AdmissionPolicy(rate_limit=8.0,
+                                                 rate_burst=8.0))
+    for _ in range(4):
+        assert b.submit(_creq(2, "a")) is None
+    ref = b.submit(_creq(2, "a"))         # client a's burst is spent
+    assert ref is not None and ref.policy == "rate_limited"
+    assert "rate limit" in ref
+    assert b.rate_limited == 1
+    # one flooding client degrades only itself: b is untouched
+    assert b.submit(_creq(2, "b")) is None
+    assert b.clients["a"]["rate_limited"] == 1
+    assert b.clients["b"]["accepted"] == 1
+    st = b.admission_stats()
+    assert st["rate_limit_rows_per_s"] == 8.0 and st["enabled"]
+
+    # a shed refunds the tokens it took: client c's budget survives a
+    # full queue, so it is NOT rate_limited once the queue drains
+    b.queue_bound = 0
+    for _ in range(4):
+        ref = b.submit(_creq(2, "c"))
+        assert ref is not None and ref.policy == "shed"
+    b.queue_bound = 100
+    for _ in range(4):                    # the whole burst still there
+        assert b.submit(_creq(2, "c")) is None
+
+    # the bucket table is bounded: idle (refilled) buckets are swept
+    # once MAX_BUCKETS distinct client ids have been seen
+    b._buckets.clear()
+    b.MAX_BUCKETS = 8
+    for i in range(40):
+        b.submit(_creq(1, f"eph-{i}"))
+    assert len(b._buckets) <= 8
+
+
+def test_batcher_drr_interleaves_clients_and_bounds_one():
+    from znicz_tpu.serving import AdmissionPolicy, DynamicBatcher
+
+    b = DynamicBatcher(max_batch=4, max_delay_ms=1.0, queue_bound=1000,
+                       admission=AdmissionPolicy(fair=True, quantum=1))
+    for _ in range(12):
+        assert b.submit(_creq(1, "flood")) is None
+    for _ in range(2):
+        assert b.submit(_creq(1, "good")) is None
+    batch = b.next_batch(timeout=0.1, wait_fill=False)
+    # deficit round robin: the good client's rows ride the FIRST batch,
+    # interleaved — never parked behind the flooder's backlog
+    assert [r.client for r in batch] == ["flood", "good", "flood", "good"]
+    # the flooder alone still fills whole batches (single-queue FIFO)
+    batch = b.next_batch(timeout=0.1, wait_fill=False)
+    assert [r.client for r in batch] == ["flood"] * 4
+
+    # per-client queue bound: one client cannot monopolize queue_bound
+    b2 = DynamicBatcher(max_batch=4, max_delay_ms=1.0, queue_bound=100,
+                        admission=AdmissionPolicy(fair=True,
+                                                  client_queue_bound=4))
+    for _ in range(4):
+        assert b2.submit(_creq(1, "hog")) is None
+    ref = b2.submit(_creq(1, "hog"))
+    assert ref is not None and ref.policy == "shed" \
+        and "fair-share" in ref
+    # the hog's OWN bound refused it — client-scoped, so its breaker
+    # must not count it against the (healthy) service
+    assert ref.scope == "client"
+    assert b2.submit(_creq(1, "other")) is None
+
+
+def test_batcher_admission_toggle_mid_traffic():
+    """set_admission(off) after fair traffic drained (the bench's
+    on/off overhead toggle): the retired per-client queue coexists with
+    the shared (None-keyed) FIFO and the drain must still make progress
+    — regression for the _visiting sentinel colliding with the shared
+    queue's None key (an infinite DRR loop under the queue lock)."""
+    from znicz_tpu.serving import AdmissionPolicy, DynamicBatcher
+
+    b = DynamicBatcher(max_batch=4, max_delay_ms=1.0, queue_bound=100,
+                       admission=AdmissionPolicy(fair=True))
+    assert b.submit(_creq(1, "a")) is None
+    assert [r.client for r in b.next_batch(0.1, wait_fill=False)] == ["a"]
+    b.set_admission(AdmissionPolicy(enabled=False))
+    assert b.submit(_creq(1, "a")) is None    # shared FIFO now
+    batch = b.next_batch(0.1, wait_fill=False)
+    assert batch is not None and len(batch) == 1
+    # and back on: per-client queues resume next to the shared leftover
+    b.set_admission(AdmissionPolicy(fair=True))
+    assert b.submit(_creq(1, "b")) is None
+    assert b.submit(_creq(1, "c")) is None
+    taken = []
+    while True:
+        nb = b.next_batch(0.05, wait_fill=False)
+        if nb is None:
+            break
+        taken += [r.client for r in nb]
+    assert sorted(taken) == ["b", "c"]
 
 
 # -- codec extraction (ISSUE 4 satellite) -------------------------------------
@@ -392,6 +526,510 @@ def test_chaos_soak_serving():
             assert srv.bad_frames > 0
         assert srv.served >= len(payloads)
     finally:
+        proxy.stop()
+        srv.stop()
+
+
+# -- circuit breaker (ISSUE 6) ------------------------------------------------
+
+
+def _fake_ok_service(endpoint, stop_evt, ready_evt):
+    """A model-free ROUTER peer answering every infer with ok+y — the
+    breaker's 'service came back' half, without paying a jit warmup."""
+    import zmq
+
+    from znicz_tpu.parallel import wire
+
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.ROUTER)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.bind(endpoint)
+    ready_evt.set()
+    try:
+        while not stop_evt.is_set():
+            if not sock.poll(20):
+                continue
+            frames = sock.recv_multipart()
+            envelope, payload = wire.split_envelope(frames)
+            req, _ = wire.decode_message(payload)
+            rep = {"ok": True, "req_id": req.get("req_id"), "gen": 1,
+                   "y": np.zeros((1, 2), np.float32)}
+            sock.send_multipart(list(envelope)
+                                + wire.encode_message(rep)[0])
+    finally:
+        sock.close(0)
+
+
+def test_circuit_breaker_opens_backs_off_and_recovers():
+    from znicz_tpu.serving import CircuitOpenError, InferenceClient
+
+    endpoint = "tcp://127.0.0.1:17593"    # nothing listening yet
+    cli = InferenceClient(endpoint, timeout=5, resend_after_s=0.05,
+                          max_resends=1, breaker_window=4,
+                          breaker_failures=2, breaker_reset_s=0.3)
+    x = np.zeros((1, 4), np.float32)
+    stop_evt = threading.Event()
+    ready_evt = threading.Event()
+    t = None
+    try:
+        # the capped resend loop gives up readably and counts it
+        # (ISSUE 6 satellite: max_resends mirrors connect_retries)
+        for _ in range(2):
+            with pytest.raises(TimeoutError, match="giving up"):
+                cli.infer(x)
+        assert cli.give_ups == 2
+        # two failures in the window >= threshold: breaker OPEN, the
+        # next submit fails fast LOCALLY
+        assert cli.breaker_state == "open"
+        assert cli.breaker_opens == 1
+        with pytest.raises(CircuitOpenError, match="circuit open"):
+            cli.submit(x)
+        assert cli.breaker_short_circuits == 1
+        # service comes back; after the backoff ONE probe goes through
+        t = threading.Thread(target=_fake_ok_service,
+                             args=(endpoint, stop_evt, ready_evt),
+                             daemon=True)
+        t.start()
+        assert ready_evt.wait(10)
+        time.sleep(0.35)                  # past breaker_reset_s
+        rid = cli.submit(x)               # the half-open probe
+        assert cli.breaker_state == "half_open"
+        assert cli.breaker_probes == 1
+        with pytest.raises(CircuitOpenError, match="half-open"):
+            cli.submit(x)                 # only ONE probe in flight
+        rep = cli.result(rid, timeout=10)
+        assert rep["ok"]
+        assert cli.breaker_state == "closed"   # probe success closes it
+        cli.infer(x, timeout=10)          # and traffic flows again
+    finally:
+        stop_evt.set()
+        if t is not None:
+            t.join(timeout=10)
+        cli.close()
+
+
+# -- fairness + refusal-policy propagation (ISSUE 6) --------------------------
+
+
+def _good_window(clis, x1, duration, pace_hz):
+    """Paced sequential windows for N well-behaved clients (each offers
+    ``pace_hz`` req/s — under its rate limit, as a well-behaved tenant
+    does); returns (accepted requests/s across all, p99 ms)."""
+    lats = []
+    errs = []
+
+    def drive(cli):
+        interval = 1.0 / pace_hz
+        t_end = time.perf_counter() + duration
+        nxt = time.perf_counter()
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                return
+            if now < nxt:
+                time.sleep(min(nxt - now, 0.005))
+                continue
+            # no catch-up bursts after a slow reply: a real paced
+            # client skips ticks, it does not hammer
+            nxt = max(nxt + interval, now)
+            t0 = time.perf_counter()
+            try:
+                cli.infer(x1)
+            except Exception as exc:      # pragma: no cover - failure path
+                errs.append(exc)
+                return
+            lats.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=drive, args=(c,)) for c in clis]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return (len(lats) / duration,
+            float(np.percentile(np.asarray(lats) * 1e3, 99)))
+
+
+def _run_fairness(srv, rate, n_good, window_s, rounds, factor=10.0):
+    """Interleaved no-flood/flood windows (PR-4 best-of discipline: a
+    host load spike only ever slows a window, and it hits both
+    variants); asserts the 20% fairness band and the flooder's
+    refusal-policy purity.  The flooder runs in its OWN process
+    (chaos.FloodProcess): a real misbehaving tenant shares no GIL with
+    the service, while an in-process flood thread would bill its own
+    Python overhead onto every good-client latency sample on this
+    1-core container."""
+    import sys
+
+    from znicz_tpu.parallel.chaos import FloodProcess
+    from znicz_tpu.serving import InferenceClient
+
+    x1 = np.zeros((1, 784), np.float32)
+    pace_hz = rate / 2                    # each good client offers half
+    # its own rate limit — well-behaved by construction
+    clis = [InferenceClient(srv.endpoint, timeout=60)
+            for _ in range(n_good)]
+    base_t, base_p, fl_t, fl_p = [], [], [], []
+    stats = {}
+    flood = FloodProcess(srv.endpoint, 784, rate, factor=factor)
+    switch = sys.getswitchinterval()
+    sys.setswitchinterval(1e-3)           # bench discipline: don't let
+    # 5ms GIL slices dominate the p99 of a multi-thread window
+    try:
+        _good_window(clis, x1, 0.3, pace_hz)      # warm the path
+        for _ in range(rounds):
+            t, p = _good_window(clis, x1, window_s, pace_hz)
+            base_t.append(t)
+            base_p.append(p)
+            flood.start_flood()
+            time.sleep(0.15)              # flood reaches steady state
+            t, p = _good_window(clis, x1, window_s, pace_hz)
+            fl_t.append(t)
+            fl_p.append(p)
+            stats = flood.stop_flood()
+            # the flooder is refused by exactly ONE policy: its own
+            # rate limit — never shed/deadline collateral
+            assert stats["refusals"].get("rate_limited", 0) > 0, stats
+            assert set(stats["refusals"]) == {"rate_limited"}, stats
+            assert stats["accepted"] > 0  # its fair share still served
+            if max(fl_t) >= 0.8 * max(base_t) \
+                    and min(fl_p) <= 1.2 * min(base_p):
+                break                     # band met; stop burning time
+    finally:
+        sys.setswitchinterval(switch)
+        flood.close()
+        for c in clis:
+            c.close()
+    # best-of both variants: well-behaved clients keep >= 80% of their
+    # no-flood throughput and p99 within 20%
+    assert max(fl_t) >= 0.8 * max(base_t), (base_t, fl_t)
+    assert min(fl_p) <= 1.2 * min(base_p), (base_p, fl_p)
+    return stats
+
+
+def test_fairness_under_flood_and_refusal_policies_lean():
+    from znicz_tpu.serving import (AdmissionPolicy, InferenceClient,
+                                   InferenceError, InferenceServer)
+
+    wf = _tiny_mnist_wf()
+    rate = 20.0                           # rows/s per client — the
+    # flood offers 200/s, a packet rate this 1-core container's router
+    # absorbs while refusing (CPU itself is not a resource admission
+    # control can ration; the flood's WORK must fit the host)
+    srv = InferenceServer(
+        wf, max_batch=8, max_delay_ms=2.0, queue_bound=64,
+        admission=AdmissionPolicy(rate_limit=rate,
+                                  rate_burst=rate / 4)).start()
+    try:
+        # 6 best-of rounds with early exit (usually 1-2 run): this
+        # box's cgroup share swings 4x minute-to-minute, and a 3-round
+        # run can land entirely inside one bad phase
+        _run_fairness(srv, rate, n_good=2, window_s=2.0, rounds=6)
+
+        # refusal-policy propagation: every refusal reply NAMES the
+        # policy that refused it
+        cli = InferenceClient(srv.endpoint, timeout=30)
+        try:
+            with pytest.raises(InferenceError) as ei:
+                cli.infer(np.zeros((9, 784), np.float32))
+            assert ei.value.reply["policy"] == "oversized"
+            with pytest.raises(InferenceError) as ei:
+                cli.infer(np.zeros((1, 784), np.float32),
+                          deadline_s=1e-6)
+            rep = ei.value.reply
+            assert rep["policy"] == "deadline" and rep["timed_out"]
+            srv.batcher.queue_bound = 0   # squeeze: everything sheds
+            try:
+                with pytest.raises(InferenceError) as ei:
+                    cli.infer(np.zeros((1, 784), np.float32))
+            finally:
+                srv.batcher.queue_bound = 64
+            assert ei.value.reply["policy"] == "shed"
+            # a GLOBAL shed is service-scoped on the wire (the breaker
+            # counts it); per-client refusals say scope=client
+            assert ei.value.reply["scope"] == "service"
+            # the panel's per-client admission table saw the flooder
+            adm = cli.stats()["batcher"]["admission"]
+            assert adm["clients"]["flooder"]["rate_limited"] > 0
+            assert srv.batcher.rate_limited > 0
+            assert srv.stats()["rejected"] > 0
+        finally:
+            cli.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_fairness_soak_full():
+    """The full fairness proof: longer interleaved windows, 3
+    well-behaved clients, flood at 10x the rate limit.  More best-of
+    rounds than the lean version: with three paced client threads the
+    per-window p99 rides on ~10 GIL handoffs per request, and this
+    container's cgroup share swings 4x minute-to-minute — early-exit
+    keeps the usual cost at one or two rounds."""
+    from znicz_tpu.serving import AdmissionPolicy, InferenceServer
+
+    wf = _tiny_mnist_wf()
+    rate = 20.0
+    srv = InferenceServer(
+        wf, max_batch=8, max_delay_ms=2.0, queue_bound=128,
+        admission=AdmissionPolicy(rate_limit=rate,
+                                  rate_burst=rate / 4)).start()
+    try:
+        _run_fairness(srv, rate, n_good=3, window_s=4.0, rounds=8)
+    finally:
+        srv.stop()
+
+
+# -- zero-downtime snapshot rollover (ISSUE 6) --------------------------------
+
+
+def _perturbed_snapshot(wf, tmp_path, tag="gen2"):
+    """Nudge every forward param and save — a second snapshot whose
+    outputs are bit-distinguishable from the served generation's."""
+    wf.snapshotter.directory = str(tmp_path)
+    for f in wf.forwards:
+        for k, a in f.params().items():
+            a.mem = np.asarray(a.map_read()) * np.float32(1.25) \
+                + np.float32(0.01)
+    return wf.snapshotter.save(tag)
+
+
+def _gen_refs(srv, x1):
+    """Per-rung reference outputs of the CURRENT generation for one
+    row (any rung a coalesced request may ride)."""
+    return {b: srv.runner.infer(srv.runner.pad(x1, b))[:1]
+            for b in srv.batcher.ladder.rungs}
+
+
+def test_rollover_under_load_readiness_and_health(tmp_path):
+    import urllib.error
+
+    from znicz_tpu.parallel.chaos import FaultSchedule
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.web_status import WebStatus
+
+    wf = _tiny_mnist_wf()
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                          queue_bound=64).start()
+    status = WebStatus(port=0).start()
+    status.register_inference(srv)
+    rng = np.random.default_rng(31)
+    x1 = rng.normal(0, 1, (1, 784)).astype(np.float32)
+    results = []
+    errs = []
+    stop = threading.Event()
+    loader = None
+    try:
+        ref_a = _gen_refs(srv, x1)        # generation-1 oracle, per rung
+        path_b = _perturbed_snapshot(wf, tmp_path)
+        assert srv.ready() and srv.alive()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/readyz") as r:
+            assert r.status == 200 and json.load(r)["ready"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/healthz") as r:
+            assert r.status == 200 and json.load(r)["ok"]
+        # 100%-probability stalls (the new chaos kind) slow every
+        # dispatch AND the swap's bucket warm, so the not-ready window
+        # is wide enough to observe deterministically
+        srv.runner.inject_compute_faults(
+            FaultSchedule(99, stall=1.0, stall_s=(0.02, 0.02)))
+
+        def load():
+            cli = InferenceClient(srv.endpoint, timeout=60)
+            try:
+                while not stop.is_set():
+                    rep = cli.result(cli.submit(x1))
+                    results.append((rep["gen"], rep["y"]))
+            except Exception as exc:      # pragma: no cover - failure
+                errs.append(exc)
+            finally:
+                cli.close()
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        t0 = time.perf_counter()
+        while len(results) < 3 and not errs:      # gen-1 replies exist
+            assert time.perf_counter() - t0 < 30
+            time.sleep(0.005)
+        cli2 = InferenceClient(srv.endpoint, timeout=30)
+        try:
+            rep = cli2.swap(path_b)       # the wire rollover trigger
+            assert rep["ok"] and rep["swap_started"]
+            assert rep["generation"] == 1     # still serving gen 1
+            saw_warming = False
+            t0 = time.perf_counter()
+            while srv.runner.generation == 1:
+                if srv.runner.swapping and not srv.ready():
+                    saw_warming = True    # /readyz false DURING warm
+                assert time.perf_counter() - t0 < 60
+                time.sleep(0.001)
+            assert saw_warming
+            t0 = time.perf_counter()
+            while not srv.ready():        # and true again after
+                assert time.perf_counter() - t0 < 30
+                time.sleep(0.002)
+            n_now = len(results)
+            t0 = time.perf_counter()
+            while len(results) < n_now + 3 and not errs:  # gen-2 traffic
+                assert time.perf_counter() - t0 < 30
+                time.sleep(0.005)
+        finally:
+            cli2.close()
+        stop.set()
+        loader.join(timeout=60)
+        assert not errs, errs
+        # ZERO accepted requests lost: the sync load loop got an ok
+        # reply for every submit, and the server's accounting agrees
+        assert srv.served == len(results)
+        assert srv.timed_out == 0 and srv.rejected == 0
+        # never a mixed-generation answer: every reply's rows are
+        # bit-exact under exactly its stamped generation's params (at
+        # whatever rung it rode), and generations flip once, in order
+        srv.runner.inject_compute_faults(FaultSchedule(99, stall=0.0))
+        ref_b = _gen_refs(srv, x1)
+        # the proof is non-vacuous: the two generations really answer
+        # differently (else "bit-exact under its gen" proves nothing)
+        assert not np.array_equal(ref_a[1], ref_b[1])
+        gens = [g for g, _ in results]
+        assert gens == sorted(gens) and gens[0] == 1 and gens[-1] == 2
+        assert set(gens) == {1, 2}
+        for g, y in results:
+            refs = ref_a if g == 1 else ref_b
+            assert any(np.array_equal(y, r) for r in refs.values()), g
+        assert srv.runner.swaps == 1
+        assert srv.runner._m_stalls.value > 0     # the stall kind fired
+        # swap refusals keep the live generation: an empty path is
+        # refused inline, a missing file fails in the background with
+        # no flip, and the service keeps answering
+        cli3 = InferenceClient(srv.endpoint, timeout=30)
+        try:
+            from znicz_tpu.serving import InferenceError
+
+            with pytest.raises(InferenceError, match="path"):
+                cli3.swap("")
+            rep = cli3.swap(str(tmp_path / "missing.pkl.gz"))
+            assert rep["swap_started"]
+            t0 = time.perf_counter()
+            while srv.runner.swap_failures == 0:
+                assert time.perf_counter() - t0 < 30
+                time.sleep(0.01)
+            assert srv.runner.generation == 2     # unchanged
+            assert cli3.infer(x1).shape == (1, 10)
+        finally:
+            cli3.close()
+        # draining: stop() flips /readyz to 503 with the reason
+        srv.stop()
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/readyz")
+        assert he.value.code == 503
+        assert json.loads(he.value.read())["reason"] == "draining"
+    finally:
+        stop.set()
+        if loader is not None:
+            loader.join(timeout=10)
+        status.stop()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_rollover_flood_stall(tmp_path):
+    """The ISSUE 6 soak: snapshot swap + one flooding client + seeded
+    compute stalls + drop/corrupt/dup/delay network faults, all
+    concurrently.  Every accepted request's result is bit-exact under
+    its stamped generation; every flooder refusal is rate_limited;
+    every proxy-corrupted request is accounted in bad_frames."""
+    from znicz_tpu.parallel.chaos import (ChaosProxy, FaultSchedule,
+                                          FloodDriver)
+    from znicz_tpu.serving import (AdmissionPolicy, InferenceClient,
+                                   InferenceServer)
+
+    wf = _tiny_mnist_wf()
+    rate = 30.0                           # modest: the single-threaded
+    # proxy relays flood + good traffic on one shared core, and this
+    # soak asserts accounting/bit-exactness, not latency bands
+    srv = InferenceServer(
+        wf, max_batch=4, max_delay_ms=2.0, queue_bound=64,
+        request_ttl_s=30.0,
+        admission=AdmissionPolicy(rate_limit=rate,
+                                  rate_burst=rate / 2)).start()
+    schedule = FaultSchedule(4242, drop=0.04, corrupt=0.04,
+                             duplicate=0.04, delay=0.04,
+                             delay_s=(0.01, 0.04),
+                             stall=0.25, stall_s=(0.005, 0.02))
+    proxy = ChaosProxy("tcp://127.0.0.1:17594", srv.endpoint,
+                       schedule).start()
+    rng = np.random.default_rng(17)
+    x1 = rng.normal(0, 1, (1, 784)).astype(np.float32)
+    payloads = [rng.normal(0, 1, (1 + i % 4, 784)).astype(np.float32)
+                for i in range(18)]
+    ladder = srv.batcher.ladder
+    # generation-1 oracles for every payload at every rung it may ride,
+    # computed BEFORE the swap exists (gen-1 params are gone after)
+    ref_a_full = {
+        i: [srv.runner.infer(srv.runner.pad(x, b))[:len(x)]
+            for b in ladder.rungs if b >= len(x)]
+        for i, x in enumerate(payloads)}
+    ref_a_full["flood"] = [srv.runner.infer(srv.runner.pad(x1, b))[:1]
+                           for b in ladder.rungs]
+    path_b = _perturbed_snapshot(wf, tmp_path)
+    srv.runner.inject_compute_faults(schedule)
+    got = [None] * len(payloads)          # (gen, y) per request
+    errs = []
+
+    def worker(wid):
+        cli = InferenceClient("tcp://127.0.0.1:17594", timeout=120,
+                              resend_after_s=0.3)
+        try:
+            for i in range(wid, len(payloads), 3):
+                rep = cli.result(cli.submit(payloads[i]))
+                got[i] = (rep["gen"], rep["y"])
+        except Exception as exc:          # pragma: no cover - failure
+            errs.append((wid, exc))
+        finally:
+            cli.close()
+
+    flood = FloodDriver("tcp://127.0.0.1:17594", x1, rate,
+                        factor=10.0).start()
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    srv.swap_async(path_b)                # rollover mid-chaos
+    try:
+        for t in threads:
+            t.join(timeout=240)
+        flood.stop()
+        assert not errs, errs
+        assert all(g is not None for g in got)
+        t0 = time.perf_counter()
+        while srv.runner.swapping:        # let the flip land
+            assert time.perf_counter() - t0 < 60
+            time.sleep(0.01)
+        assert srv.runner.generation == 2
+        srv.runner.inject_compute_faults(FaultSchedule(1, stall=0.0))
+        ref_b_full = {
+            i: [srv.runner.infer(srv.runner.pad(x, b))[:len(x)]
+                for b in ladder.rungs if b >= len(x)]
+            for i, x in enumerate(payloads)}
+        assert not np.array_equal(ref_a_full[0][0], ref_b_full[0][0])
+        # bit-exact under the STAMPED generation, at whatever rung the
+        # request rode — zero cross-request/cross-generation leakage
+        for i, (g, y) in enumerate(got):
+            assert g in (1, 2), (i, g)
+            refs = ref_a_full[i] if g == 1 else ref_b_full[i]
+            assert any(np.array_equal(y, r) for r in refs), (i, g)
+        assert flood.accepted > 0
+        assert flood.refusals.get("rate_limited", 0) > 0
+        assert set(flood.refusals) == {"rate_limited"}, flood.refusals
+        assert srv.bad_frames == proxy.counters["req"]["corrupt"]
+        assert srv.runner._m_stalls.value > 0
+        assert srv.served >= len(payloads)
+    finally:
+        flood.stop()
         proxy.stop()
         srv.stop()
 

@@ -27,6 +27,7 @@ import argparse
 import importlib
 import importlib.util
 import os
+import signal
 import sys
 from typing import List, Optional
 
@@ -271,6 +272,26 @@ class Launcher:
         server.start()
         print(f"serving {wf.name} at {server.endpoint} "
               f"(snapshot: {args.snapshot or 'fresh init'})", flush=True)
+        # zero-downtime rollover on SIGHUP (ISSUE 6): re-load --snapshot
+        # (the conventional "new weights land at the same path" flow)
+        # and flip generations without dropping a request.  Signals can
+        # only be wired from the main thread (tests drive main() from a
+        # worker thread — they use the wire `swap` command instead).
+        import threading
+
+        if args.snapshot and hasattr(signal, "SIGHUP") \
+                and threading.current_thread() is threading.main_thread():
+            def _rollover(signum, frame):
+                try:
+                    server.swap_async(args.snapshot)
+                    print(f"SIGHUP: snapshot rollover from "
+                          f"{args.snapshot} started", flush=True)
+                except RuntimeError as exc:    # overlapping swap
+                    print(f"SIGHUP ignored: {exc}", flush=True)
+
+            signal.signal(signal.SIGHUP, _rollover)
+            print("SIGHUP triggers a zero-downtime snapshot rollover",
+                  flush=True)
         try:
             server.join()
         except KeyboardInterrupt:

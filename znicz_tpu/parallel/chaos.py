@@ -20,7 +20,16 @@ Three pieces:
   - process-level kill harnesses: :func:`take_job_and_die` (a slave that
     takes a job and vanishes mid-job) and :class:`MasterHarness`
     (kill/restart a Server mid-epoch, restoring from its crash-resume
-    snapshot — the ``--master-resume`` path).
+    snapshot — the ``--master-resume`` path);
+  - compute/resource faults (ISSUE 6): the schedule additionally
+    carries ``stall`` decisions — a SEPARATE seeded stream
+    (:meth:`FaultSchedule.decide_compute`, so existing wire-fault
+    schedules replay unchanged) that the serving ``ModelRunner``'s
+    ``inject_compute_faults`` hook turns into slow-compute sleeps — and
+    :class:`FloodDriver`, one client hammering an inference service at
+    N× its per-client rate limit, accounting every accepted reply and
+    every refusal by the ``policy`` that refused it (the batcher's
+    admission counters are the server-side half of that accounting).
 
 Everything is CPU-only, in-process, and seeded: the chaos suite runs
 deterministically in CI forever after (ISSUE 2).
@@ -51,19 +60,34 @@ class FaultSchedule:
     also becomes a (counted) client reconnect.
     """
 
+    #: salt for the compute-fault decision stream — decide_compute(i)
+    #: must not correlate with decide(i), and adding stall to a schedule
+    #: must leave its WIRE decisions byte-identical
+    COMPUTE_SALT = 0x57A11
+
     def __init__(self, seed: int, drop: float = 0.0, corrupt: float = 0.0,
                  duplicate: float = 0.0, delay: float = 0.0,
-                 delay_s: Tuple[float, float] = (0.05, 0.2)):
+                 delay_s: Tuple[float, float] = (0.05, 0.2),
+                 stall: float = 0.0,
+                 stall_s: Tuple[float, float] = (0.02, 0.1)):
         total = drop + corrupt + duplicate + delay
         if not 0.0 <= total < 1.0:
             raise ValueError(f"fault probabilities sum to {total}; "
                              "must be in [0, 1)")
+        if not 0.0 <= stall <= 1.0:
+            raise ValueError(f"stall probability {stall} not in [0, 1]")
         self.seed = int(seed)
         self.drop = float(drop)
         self.corrupt = float(corrupt)
         self.duplicate = float(duplicate)
         self.delay = float(delay)
         self.delay_s = (float(delay_s[0]), float(delay_s[1]))
+        #: compute-fault stream (ISSUE 6): probability a model dispatch
+        #: stalls, and the stall-length range — keep the upper bound
+        #: well under request deadlines or every stall also becomes a
+        #: (counted) deadline refusal
+        self.stall = float(stall)
+        self.stall_s = (float(stall_s[0]), float(stall_s[1]))
 
     def decide(self, frame_no: int) -> Tuple[str, float]:
         """(action, delay_seconds) for the frame_no-th frame."""
@@ -88,6 +112,19 @@ class FaultSchedule:
         """The first ``n`` decisions — the full fault schedule a run of
         ``n`` frames would see (the determinism-test surface)."""
         return [self.decide(i) for i in range(n)]
+
+    def decide_compute(self, dispatch_no: int) -> Tuple[str, float]:
+        """(action, stall_seconds) for the dispatch_no-th model
+        dispatch: ``("stall", s)`` or ``("run", 0.0)``.  A separate
+        pure-function-of-(seed, dispatch_no) stream — wire decisions
+        for the same indices are untouched."""
+        rng = np.random.default_rng(
+            (self.seed, int(dispatch_no), self.COMPUTE_SALT))
+        u = float(rng.random())
+        if u < self.stall:
+            lo, hi = self.stall_s
+            return "stall", lo + float(rng.random()) * (hi - lo)
+        return "run", 0.0
 
 
 def corrupt_payload(payload: bytes) -> bytes:
@@ -248,6 +285,161 @@ class ChaosProxy:
             back.close(0)
 
 
+# -- resource-fault drivers (ISSUE 6) ------------------------------------------
+
+
+class FloodDriver:
+    """One client flooding an inference service at ``factor``× its
+    per-client rate limit — the admission-control fairness proof's
+    misbehaving tenant.
+
+    Open-loop single-request arrivals at ``rate_rows_per_s * factor``
+    on a daemon thread; every reply is accounted, none raises:
+    ``accepted`` counts ok replies, ``refusals`` buckets refusal
+    replies by the ``policy`` that refused them (a fairness test
+    asserts this is ALL ``rate_limited``).  The breaker is disabled on
+    purpose — a polite client would back off, and the flood must not.
+    """
+
+    def __init__(self, endpoint: str, x, rate_rows_per_s: float,
+                 factor: float = 10.0, client_id: str = "flooder",
+                 max_in_flight: int = 256):
+        self.endpoint = endpoint
+        self.x = x
+        self.rate = float(rate_rows_per_s) * float(factor)
+        self.client_id = client_id
+        self.max_in_flight = int(max_in_flight)
+        self.accepted = 0
+        self.refusals: Dict[str, int] = {}
+        self.sent = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def outcomes(self) -> int:
+        return self.accepted + sum(self.refusals.values())
+
+    def start(self) -> "FloodDriver":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="chaos-flood")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        from znicz_tpu.serving.client import InferenceClient
+
+        cli = InferenceClient(self.endpoint, timeout=60.0,
+                              resend_after_s=5.0, max_resends=100,
+                              client_id=self.client_id,
+                              breaker_failures=0)
+        t0 = time.perf_counter()
+        try:
+            while not self._stop.is_set():
+                # burst catch-up: send EVERY due request, not one per
+                # loop tick — the offered rate must actually reach
+                # factor x rate_limit, not the loop's poll cadence
+                while (time.perf_counter() - t0 >= self.sent / self.rate
+                       and cli.in_flight < self.max_in_flight
+                       and not self._stop.is_set()):
+                    cli.submit(self.x)
+                    self.sent += 1
+                for rep in cli.collect(0.002):
+                    if rep.get("ok"):
+                        self.accepted += 1
+                    else:
+                        pol = rep.get("policy", "error")
+                        self.refusals[pol] = self.refusals.get(pol, 0) + 1
+        except Exception:                   # pragma: no cover - driver
+            pass                            # a dying flood is just quiet
+        finally:
+            cli.close()
+
+
+class FloodProcess:
+    """:class:`FloodDriver` in a SEPARATE interpreter process — the
+    honest tenant model for latency-band assertions: a real flooding
+    client shares no GIL with the service or the well-behaved clients,
+    while an in-process flood thread bills its own Python overhead
+    onto every latency sample of everything else on a 1-core host.
+
+    The child is ``python -m znicz_tpu.parallel.chaos --flood ...`` (no
+    jax import — it comes up in <1s); flood windows are toggled over
+    stdin (``start``/``stop``), each ``stop`` returning the window's
+    accounting (sent/accepted/refusals-by-policy) as one JSON line.
+    """
+
+    def __init__(self, endpoint: str, sample_dim: int,
+                 rate_rows_per_s: float, factor: float = 10.0,
+                 client_id: str = "flooder", max_in_flight: int = 32):
+        import subprocess
+        import sys
+
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu.parallel.chaos", "--flood",
+             endpoint, str(int(sample_dim)), str(float(rate_rows_per_s)),
+             str(float(factor)), client_id, str(int(max_in_flight))],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            bufsize=1)
+        line = self._proc.stdout.readline().strip()
+        if line != "ready":                 # pragma: no cover - defensive
+            raise RuntimeError(f"flood child failed to come up: {line!r}")
+
+    def start_flood(self) -> None:
+        self._proc.stdin.write("start\n")
+        self._proc.stdin.flush()
+
+    def stop_flood(self) -> Dict:
+        """Stop the current flood window; returns its accounting."""
+        import json
+
+        self._proc.stdin.write("stop\n")
+        self._proc.stdin.flush()
+        return json.loads(self._proc.stdout.readline())
+
+    def close(self) -> None:
+        try:
+            self._proc.stdin.write("quit\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, ValueError):  # pragma: no cover
+            pass
+        self._proc.wait(timeout=30)
+
+
+def _flood_main(argv: List[str]) -> None:  # pragma: no cover - subprocess
+    """Child half of :class:`FloodProcess` (kept here so the flood
+    logic has ONE home — this just wraps FloodDriver in a stdin/stdout
+    command loop)."""
+    import json
+    import sys
+
+    endpoint, dim, rate, factor, client_id, mif = argv
+    x = np.zeros((1, int(dim)), np.float32)
+    print("ready", flush=True)
+    driver: Optional[FloodDriver] = None
+    for line in sys.stdin:
+        cmd = line.strip()
+        if cmd == "start" and driver is None:
+            driver = FloodDriver(endpoint, x, float(rate),
+                                 factor=float(factor),
+                                 client_id=client_id,
+                                 max_in_flight=int(mif)).start()
+        elif cmd == "stop" and driver is not None:
+            driver.stop()
+            print(json.dumps({"sent": driver.sent,
+                              "accepted": driver.accepted,
+                              "refusals": driver.refusals}), flush=True)
+            driver = None
+        elif cmd == "quit":
+            break
+    if driver is not None:
+        driver.stop()
+
+
 # -- process-level kill harness ------------------------------------------------
 
 
@@ -340,3 +532,10 @@ class MasterHarness:
         """Join the serving thread; True when it exited (run complete)."""
         self._thread.join(timeout)
         return not self._thread.is_alive()
+
+
+if __name__ == "__main__":              # pragma: no cover - subprocess
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--flood":
+        _flood_main(sys.argv[2:])

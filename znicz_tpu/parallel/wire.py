@@ -36,6 +36,16 @@ A peer still speaking v2 framing (one pickled frame) is detected by the
 missing magic; :func:`decode_message` returns it with ``legacy=True`` so
 the server can answer in kind — including the clear protocol-version
 refusal an out-of-date slave must receive in a format it can read.
+
+Optional metadata keys ride the pickled skeleton and cost nothing when
+absent; old peers decode them as unknown dict entries and ignore them.
+The conventions so far: ``trace_id`` (ISSUE 5 cross-process span
+correlation), and — serving, ISSUE 6 — ``deadline_ms`` (a per-request
+deadline BUDGET; budgets cross the wire, never absolute timestamps,
+because peer clocks differ), ``client`` (admission identity for rate
+limits / fair queueing), ``policy`` (which admission policy refused a
+request: shed / oversized / rate_limited / deadline) and ``gen`` (the
+snapshot generation that computed a reply).
 """
 
 from __future__ import annotations

@@ -19,13 +19,25 @@ codec the master/slave stack speaks.
     serving/client.py    InferenceClient — DEALER peer, pipelined
                          submits, resend-on-loss, req_id dedup
 
+Overload safety + live operation (ISSUE 6): per-client token-bucket
+rate limits and deficit-round-robin fair queueing in the batcher
+(``root.common.serving.admission.*``), end-to-end deadline budgets
+(client ships ``deadline_ms``, the frontend refuses expired work at
+ingress/assemble/post-compute), a rolling-window circuit breaker in
+the client, and zero-downtime snapshot rollover (``swap`` control
+command / SIGHUP; every reply carries its snapshot ``gen``) with
+``/healthz``/``/readyz`` on web_status.
+
 Config home: ``root.common.serving.{max_batch, max_delay_ms,
-queue_bound, request_ttl_s}``; CLI: ``python -m znicz_tpu <workflow>
---serve [BIND] --snapshot FILE``; bench gate: ``python bench.py
---serve`` (see README "Serving").
+queue_bound, request_ttl_s}`` + ``root.common.serving.admission.*``;
+CLI: ``python -m znicz_tpu <workflow> --serve [BIND] --snapshot FILE``;
+bench gate: ``python bench.py --serve`` (see README "Serving" and
+"Serving robustness").
 """
 
-from .batcher import BucketLadder, DynamicBatcher, Request  # noqa: F401
-from .client import InferenceClient, InferenceError         # noqa: F401
+from .batcher import (AdmissionPolicy, BucketLadder,        # noqa: F401
+                      DynamicBatcher, Refusal, Request, TokenBucket)
+from .client import (CircuitOpenError, InferenceClient,     # noqa: F401
+                     InferenceError)
 from .frontend import InferenceServer                       # noqa: F401
 from .model import ModelRunner                              # noqa: F401

@@ -20,6 +20,18 @@ distinct batch shape is a fresh XLA compile).  The batcher attacks both:
     exceed ``queue_bound`` is shed immediately (counted, refused with a
     readable reason) instead of growing an unbounded backlog whose every
     entry would time out anyway.
+  - **Admission control** (ISSUE 6): per-client token-bucket rate
+    limits and weighted fair queueing.  Each client gets its own
+    subqueue; ``next_batch`` drains them with deficit round robin
+    (rows-weighted: each visit banks ``quantum`` rows, a request is
+    taken when its client's deficit covers it), so one flooding client
+    degrades only itself — its excess is refused ``rate_limited`` at
+    submit, and whatever it does get queued cannot starve other
+    clients' drain share.  Every refusal is a :class:`Refusal`: still
+    the readable string the frontend always shipped, now carrying the
+    ``policy`` name (``shed`` / ``oversized`` / ``rate_limited`` /
+    ``deadline`` / ``draining``) so a client can tell WHICH policy
+    refused it.  Config home: ``root.common.serving.admission.*``.
 
 Threading contract: ``submit`` may be called from the frontend's router
 thread; ``next_batch`` from the single compute thread.  All state is
@@ -75,16 +87,125 @@ class BucketLadder:
         return f"BucketLadder({self.rungs})"
 
 
+#: "no client is mid-visit" marker for the DRR drain.  A dedicated
+#: sentinel, NOT None: None is also the shared-queue KEY when fairness
+#: is off, and conflating the two made the drain skip that queue's
+#: quantum banking forever (an infinite loop under the queue lock the
+#: first time a retired per-client queue coexisted with the shared one)
+_NO_VISIT = object()
+
+
+class Refusal(str):
+    """A refusal reason: the plain readable string the frontend always
+    shipped, additionally carrying the ``policy`` slug (``shed`` /
+    ``oversized`` / ``rate_limited`` / ``deadline`` / ``draining``) the
+    reply names, so a refused client can react per policy (back off on
+    ``rate_limited``, split on ``oversized``, ...) without parsing
+    prose.  ``scope`` says WHOSE limit refused: ``"client"`` (this
+    caller's own quota/bound — the service is healthy) vs
+    ``"service"`` (global overload/shutdown) — the client circuit
+    breaker counts only service-scoped sheds as failures, so a caller
+    bumping its own fair-share bound never opens its breaker against a
+    healthy service."""
+
+    policy = "refused"
+    scope = "service"
+
+    def __new__(cls, policy: str, reason: str, scope: str = "service"):
+        self = super().__new__(cls, reason)
+        self.policy = policy
+        self.scope = scope
+        return self
+
+
+class TokenBucket:
+    """Per-client rate limiter: ``rate`` rows/s refill into a bucket of
+    ``burst`` rows capacity; a submit takes its row count or is refused.
+    Burst admits a cold client's first flurry; sustained traffic is
+    capped at ``rate``."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t_last = time.perf_counter()
+
+    def try_take(self, n: int) -> bool:
+        now = time.perf_counter()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def refund(self, n: int) -> None:
+        """Return ``n`` taken tokens (a later admission stage refused
+        the request): a shed must not ALSO burn the client's rate
+        budget, or a recovering client gets rate_limited refusals it
+        never earned."""
+        self.tokens = min(self.burst, self.tokens + n)
+
+    def is_full(self, now: float) -> bool:
+        """True when the bucket has refilled to capacity — state
+        identical to a freshly built bucket, so it can be dropped and
+        lazily rebuilt without the client noticing."""
+        return min(self.burst,
+                   self.tokens + (now - self.t_last) * self.rate) \
+            >= self.burst
+
+
+class AdmissionPolicy:
+    """Admission-control knobs (config home
+    ``root.common.serving.admission.*``):
+
+      - ``rate_limit``: rows/s each client may sustain (0 = unlimited);
+      - ``rate_burst``: token-bucket capacity in rows (0 = auto:
+        ``max(rate_limit, max_batch)``);
+      - ``fair``: per-client subqueues drained deficit-round-robin
+        (off = the historical single FIFO);
+      - ``quantum``: DRR rows banked per visit (0 = auto:
+        ``max_batch // 4``, min 1);
+      - ``client_queue_bound``: queued rows ONE client may hold
+        (0 = no per-client cap — the global ``queue_bound`` is the
+        only backpressure);
+      - ``enabled``: master switch — ``bench.py --serve`` toggles it for
+        the interleaved on/off overhead gate.
+    """
+
+    __slots__ = ("rate_limit", "rate_burst", "fair", "quantum",
+                 "client_queue_bound", "enabled")
+
+    def __init__(self, rate_limit: float = 0.0, rate_burst: float = 0.0,
+                 fair: bool = True, quantum: int = 0,
+                 client_queue_bound: int = 0, enabled: bool = True):
+        self.rate_limit = float(rate_limit)
+        self.rate_burst = float(rate_burst)
+        self.fair = bool(fair)
+        self.quantum = int(quantum)
+        self.client_queue_bound = int(client_queue_bound)
+        self.enabled = bool(enabled)
+
+
 class Request:
     """One queued inference request: ``x`` is the (n_rows, *sample) host
     array, ``reply_to`` an opaque routing token the frontend uses to
     answer (the ROUTER envelope), ``req_id`` the client's correlation
-    id.  ``t_enqueued`` feeds the latency stats and the TTL check."""
+    id.  ``t_enqueued`` feeds the latency stats; ``t_deadline`` (ISSUE
+    6) is the ABSOLUTE local deadline the frontend derived at ingress
+    from the client's shipped budget (or its own TTL) — checked at
+    assemble time and again post-compute, so expired work is never
+    computed and never shipped.  ``client`` keys the admission
+    subqueue/bucket."""
 
-    __slots__ = ("x", "n", "reply_to", "req_id", "trace_id", "t_enqueued")
+    __slots__ = ("x", "n", "reply_to", "req_id", "trace_id", "client",
+                 "t_enqueued", "t_deadline")
 
     def __init__(self, x, n: int, reply_to=None, req_id=None,
-                 trace_id=None):
+                 trace_id=None, client=None, deadline_s=None):
         self.x = x
         self.n = int(n)
         self.reply_to = reply_to
@@ -92,7 +213,12 @@ class Request:
         #: optional cross-process correlation id carried in the wire-v3
         #: metadata (ISSUE 5) — echoed in the reply, tagged on spans
         self.trace_id = trace_id
+        #: admission identity (frontend: explicit ``client`` metadata,
+        #: else a digest of the ROUTER envelope)
+        self.client = client
         self.t_enqueued = time.perf_counter()
+        self.t_deadline = (None if deadline_s is None
+                           else self.t_enqueued + float(deadline_s))
 
 
 class DynamicBatcher:
@@ -109,25 +235,53 @@ class DynamicBatcher:
         "submitted": "accepted requests",
         "shed": "refused: queue at bound",
         "oversized": "refused: n > max_batch",
+        "rate_limited": "refused: client over its rate limit",
         "batches": "batches closed",
         "batched_requests": "requests inside closed batches",
         "batched_rows": "real rows inside closed batches",
         "padded_rows": "pad rows added by the ladder",
     }
 
+    #: per-client accounting table bound (plain state, not registry
+    #: series: client ids are ephemeral uuids — labeled families would
+    #: leak a series per client forever)
+    MAX_CLIENT_STATS = 32
+
+    #: token-bucket table bound: past this, fully-refilled buckets
+    #: (state == freshly built — dropping one is invisible to its
+    #: client) are swept; clients churning faster than this refill are
+    #: evicted oldest-first.  Without a bound the table grows one
+    #: entry per ephemeral client id ever seen (uuid per
+    #: InferenceClient instance) for the life of the service.
+    MAX_BUCKETS = 1024
+
     def __init__(self, max_batch: int = 32, max_delay_ms: float = 5.0,
                  queue_bound: int = 256,
-                 ladder: Optional[BucketLadder] = None):
+                 ladder: Optional[BucketLadder] = None,
+                 admission: Optional[AdmissionPolicy] = None):
         from znicz_tpu import telemetry
 
         self.ladder = ladder or BucketLadder(max_batch)
         self.max_batch = self.ladder.max_batch
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.queue_bound = int(queue_bound)
-        self._q: collections.deque = collections.deque()
+        #: per-client subqueues (key None = the shared FIFO when
+        #: fairness is off / admission disabled)
+        self._queues: "collections.OrderedDict[object, collections.deque]" \
+            = collections.OrderedDict()
+        self._rr: collections.deque = collections.deque()  # DRR rotation
+        self._deficit: Dict[object, float] = {}
+        self._visiting = _NO_VISIT          # DRR visit marker (quantum
+        #                                     banks once per visit)
+        self._client_rows: Dict[object, int] = {}
+        self._buckets: Dict[object, TokenBucket] = {}
+        #: bounded per-client admission accounting for the panel
+        self.clients: "collections.OrderedDict[str, Dict]" \
+            = collections.OrderedDict()
         self._rows = 0                      # rows currently queued
         self._cond = threading.Condition()
         self._closed = False
+        self.set_admission(admission or AdmissionPolicy())
         # -- accounting (the serving panel's inputs), homed in the
         # telemetry registry; historical attribute names preserved by
         # the class-level properties below
@@ -150,22 +304,146 @@ class DynamicBatcher:
         read shape; the counters live in the registry)."""
         return {r: c.value for r, c in self._m_bucket_hits.items()}
 
+    # -- admission -------------------------------------------------------------
+
+    def set_admission(self, policy: AdmissionPolicy) -> None:
+        """Install (or swap — the bench's on/off overhead toggle) the
+        admission policy.  Auto knobs resolve against this batcher;
+        token buckets restart (new rates must not inherit old debt).
+        Already-queued requests drain under the rotation regardless —
+        only the submit-side keying/limits change."""
+        with self._cond:
+            self.admission = policy
+            self._rate_burst = policy.rate_burst or max(
+                policy.rate_limit, float(self.max_batch))
+            self._quantum = policy.quantum or max(1, self.max_batch // 4)
+            self._buckets.clear()
+
+    @property
+    def _client_bound(self) -> int:
+        """The effective per-client queued-rows cap — derived LIVE (not
+        cached at set_admission time) so mutating ``queue_bound`` at
+        runtime cannot leave a stale fair-share bound above the whole
+        queue."""
+        return self.admission.client_queue_bound or self.queue_bound
+
+    def _sweep_buckets(self) -> None:
+        """Bound the token-bucket table (cond held).  Refilled-to-full
+        buckets are indistinguishable from freshly built ones, so
+        dropping them is lossless for their clients; only if ALL
+        buckets are mid-debt (more simultaneously active clients than
+        MAX_BUCKETS) does oldest-first eviction lose state."""
+        now = time.perf_counter()
+        for k in [k for k, b in self._buckets.items() if b.is_full(now)]:
+            del self._buckets[k]
+        while len(self._buckets) >= self.MAX_BUCKETS:
+            del self._buckets[next(iter(self._buckets))]
+
+    def _client_stat(self, client) -> Dict:
+        key = str(client)
+        st = self.clients.get(key)
+        if st is None:
+            while len(self.clients) >= self.MAX_CLIENT_STATS:
+                self.clients.popitem(last=False)    # oldest first seen
+            st = self.clients[key] = {
+                "requests": 0, "rows": 0, "accepted": 0,
+                "rate_limited": 0, "shed": 0}
+        return st
+
+    def admission_stats(self) -> Dict:
+        adm = self.admission
+        with self._cond:
+            # under the lock: the router/compute threads mutate
+            # _queues/clients mid-iteration otherwise (web_status
+            # scrapes from its own HTTP thread)
+            active = sum(1 for q in self._queues.values() if q)
+            clients = {k: dict(v) for k, v in self.clients.items()}
+        return {
+            "enabled": adm.enabled,
+            "fair": adm.fair,
+            "rate_limit_rows_per_s": adm.rate_limit,
+            "rate_burst_rows": self._rate_burst,
+            "quantum_rows": self._quantum,
+            "client_queue_bound": self._client_bound,
+            "rate_limited": self.rate_limited,
+            "active_clients": active,
+            "clients": clients,
+        }
+
     # -- producer side ---------------------------------------------------------
 
-    def submit(self, req: Request) -> Optional[str]:
+    def submit(self, req: Request) -> Optional[Refusal]:
         if req.n < 1 or req.n > self.max_batch:
             self._m["oversized"].inc()
-            return (f"request of {req.n} rows exceeds max_batch="
-                    f"{self.max_batch} (split it client-side)")
+            return Refusal(
+                "oversized",
+                f"request of {req.n} rows exceeds max_batch="
+                f"{self.max_batch} (split it client-side)",
+                scope="client")
+        adm = self.admission
         with self._cond:
             if self._closed:
-                return "service is shutting down"
+                return Refusal("draining", "service is shutting down")
+            key = None
+            bucket = None
+            if adm.enabled:
+                st = self._client_stat(req.client)
+                st["requests"] += 1
+                st["rows"] += req.n
+                if adm.rate_limit > 0:
+                    bucket = self._buckets.get(req.client)
+                    if bucket is None:
+                        if len(self._buckets) >= self.MAX_BUCKETS:
+                            self._sweep_buckets()
+                        bucket = self._buckets[req.client] = TokenBucket(
+                            adm.rate_limit, self._rate_burst)
+                    if not bucket.try_take(req.n):
+                        self._m["rate_limited"].inc()
+                        st["rate_limited"] += 1
+                        return Refusal(
+                            "rate_limited",
+                            f"client over its rate limit "
+                            f"({adm.rate_limit:g} rows/s, burst "
+                            f"{self._rate_burst:g}) — rate_limited",
+                            scope="client")
+                if adm.fair:
+                    key = req.client
+                    # explicit per-client cap only: with
+                    # client_queue_bound=0 the effective bound equals
+                    # queue_bound and client_rows <= total rows, so the
+                    # global check below already subsumes this one
+                    if (adm.client_queue_bound > 0
+                            and self._client_rows.get(key, 0) + req.n
+                            > self._client_bound):
+                        self._m["shed"].inc()
+                        st["shed"] += 1
+                        if bucket is not None:
+                            bucket.refund(req.n)
+                        return Refusal(
+                            "shed",
+                            f"client queue at its fair-share bound "
+                            f"({self._client_rows.get(key, 0)} rows "
+                            f"queued, bound {self._client_bound}) — shed",
+                            scope="client")
             if self._rows + req.n > self.queue_bound:
                 self._m["shed"].inc()
-                return (f"queue at bound ({self._rows} rows queued, "
-                        f"bound {self.queue_bound}) — shed")
-            self._q.append(req)
+                if adm.enabled:
+                    st["shed"] += 1
+                if bucket is not None:
+                    bucket.refund(req.n)
+                return Refusal(
+                    "shed",
+                    f"queue at bound ({self._rows} rows queued, "
+                    f"bound {self.queue_bound}) — shed")
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = collections.deque()
+                self._rr.append(key)
+            q.append(req)
             self._rows += req.n
+            self._client_rows[key] = self._client_rows.get(key, 0) + req.n
+            if adm.enabled:
+                st["accepted"] += 1
             self._m["submitted"].inc()
             self._cond.notify()
             return None
@@ -184,6 +462,59 @@ class DynamicBatcher:
 
     # -- consumer side ---------------------------------------------------------
 
+    def _pop(self, key) -> Request:
+        """Dequeue the head of ``key``'s subqueue (cond held)."""
+        req = self._queues[key].popleft()
+        self._rows -= req.n
+        if key in self._client_rows:
+            self._client_rows[key] -= req.n
+        return req
+
+    def _take_one(self, space: int) -> Optional[Request]:
+        """One request under deficit round robin, or None when nothing
+        queued fits ``space`` rows (requests are never split; cond
+        held).  A visited client banks ``quantum`` rows once per visit
+        and keeps its turn while its banked deficit covers its head —
+        rows-weighted fairness across clients, plain FIFO within one.
+        A client whose queue empties is retired (classic DRR: an idle
+        queue banks nothing)."""
+        rr = self._rr
+        if self._rows == 0 or not rr:
+            return None
+        if len(rr) == 1:
+            # one subqueue (single client, or fairness off): plain FIFO,
+            # no deficit bookkeeping on the hot path
+            q = self._queues[rr[0]]
+            if q and q[0].n <= space:
+                return self._pop(rr[0])
+            return None
+        if not any(q and q[0].n <= space for q in self._queues.values()):
+            return None                     # nothing fits: close batch
+        cap = float(max(self._quantum, self.max_batch))
+        while True:
+            key = rr[0]
+            q = self._queues.get(key)
+            if not q:
+                rr.popleft()                # retire the idle client
+                self._deficit.pop(key, None)
+                self._queues.pop(key, None)
+                self._client_rows.pop(key, None)
+                if self._visiting == key:
+                    self._visiting = _NO_VISIT
+                continue
+            if self._visiting != key:
+                self._visiting = key
+                self._deficit[key] = min(
+                    self._deficit.get(key, 0.0) + self._quantum, cap)
+            head = q[0]
+            if head.n <= space and self._deficit.get(key, 0.0) >= head.n:
+                self._deficit[key] -= head.n
+                return self._pop(key)
+            # head too big for the remaining space, or deficit not yet
+            # banked: this visit ends, next client's turn
+            rr.rotate(-1)
+            self._visiting = _NO_VISIT
+
     def next_batch(self, timeout: float = 0.2,
                    wait_fill: bool = True) -> Optional[List[Request]]:
         """The next coalesced batch, or None when nothing arrived within
@@ -191,7 +522,9 @@ class DynamicBatcher:
         from that moment the ``max_delay_ms`` window runs, during which
         further requests are folded in until ``max_batch`` rows are
         reached.  A request that does not fit the remaining space stays
-        queued for the next batch (requests are never split).
+        queued for the next batch (requests are never split); with
+        multiple clients queued, requests are drained deficit-round-
+        robin across the per-client subqueues (module docstring).
 
         ``wait_fill=False`` skips the window: only already-queued
         requests are taken.  That is the PIPELINED grab — the compute
@@ -201,26 +534,27 @@ class DynamicBatcher:
         ``max_delay`` on p99)."""
         with self._cond:
             deadline = time.perf_counter() + max(timeout, 0.0)
-            while not self._q:
+            while self._rows == 0:
                 if self._closed:
                     return None
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
                     return None
                 self._cond.wait(remaining)
-            batch = [self._q.popleft()]
-            rows = batch[0].n
-            self._rows -= rows
+            first = self._take_one(self.max_batch)
+            if first is None:               # pragma: no cover - defensive
+                return None
+            batch = [first]
+            rows = first.n
             flush_at = time.perf_counter() + self.max_delay_s
             while rows < self.max_batch:
-                if self._q:
-                    if self._q[0].n > self.max_batch - rows:
-                        break               # would overflow: next batch
-                    req = self._q.popleft()
-                    self._rows -= req.n
+                req = self._take_one(self.max_batch - rows)
+                if req is not None:
                     batch.append(req)
                     rows += req.n
                     continue
+                if self._rows:
+                    break                   # queued but nothing fits
                 remaining = flush_at - time.perf_counter()
                 if not wait_fill or remaining <= 0 or self._closed:
                     break
@@ -252,12 +586,14 @@ class DynamicBatcher:
             "submitted": self.submitted,
             "shed": self.shed,
             "oversized": self.oversized,
+            "rate_limited": self.rate_limited,
             "batches": self.batches,
             "batched_requests": self.batched_requests,
             "batched_rows": self.batched_rows,
             "padded_rows": self.padded_rows,
             "mean_occupancy": None if occ is None else round(occ, 4),
             "bucket_hits": dict(self.bucket_hits),
+            "admission": self.admission_stats(),
         }
 
 

@@ -8,12 +8,35 @@ just re-sends the SAME already-encoded frames after ``resend_after_s``
 (inference is pure, so a duplicate compute is wasted work, not a
 correctness problem; duplicate replies are deduplicated by ``req_id``).
 
+Overload safety (ISSUE 6):
+
+  - every request ships a ``deadline_ms`` BUDGET in the wire-v3
+    metadata (old servers ignore it, like ``trace_id``); the server
+    refuses/abandons the request once the budget is spent, so a slow
+    service never computes or ships answers nobody waits for;
+  - the resend loop is CAPPED (``max_resends``; a counted, readable
+    give-up — mirrors the master client's ``connect_retries``);
+  - a rolling-window CIRCUIT BREAKER: enough failures (give-ups, shed
+    refusals, bad frames) in the recent window OPEN the breaker and
+    ``submit`` fails fast with :class:`CircuitOpenError` instead of
+    feeding resend traffic to a dead/overloaded service; after a
+    capped-exponential backoff (PR 2's reconnect idiom) ONE half-open
+    probe is let through — success closes the breaker, failure
+    re-opens it with doubled backoff.  Per-client refusals
+    (``rate_limited`` / ``oversized`` / ``deadline``, and a shed whose
+    reply says ``scope: client`` — the caller's own fair-share queue
+    bound) do NOT trip the breaker: the service is alive and
+    answering, backing off everyone over one caller's quota would be
+    self-inflicted downtime.  Only the SERVICE-scoped shed (global
+    queue at bound) counts as overload.
+
 Messages ride the wire-v3 codec (parallel/wire.py): the request tensor
 and the result tensor are zero-copy buffer frames.
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import time
 from typing import Dict, List, Optional
@@ -25,11 +48,18 @@ from znicz_tpu.telemetry.metrics import registered_property
 
 class InferenceError(RuntimeError):
     """The service answered, but with a refusal (bad frame / shed /
-    timed out / shape mismatch); the reply dict is ``.reply``."""
+    rate_limited / deadline / shape mismatch); the reply dict is
+    ``.reply`` (``.reply.get("policy")`` names the refusing policy)."""
 
     def __init__(self, reply: dict):
         super().__init__(str(reply.get("error") or reply))
         self.reply = reply
+
+
+class CircuitOpenError(RuntimeError):
+    """The client's circuit breaker is open: the request was refused
+    LOCALLY (fail-fast, no wire traffic) because the service recently
+    failed too often.  Retry after the breaker's backoff."""
 
 
 class InferenceClient:
@@ -39,7 +69,12 @@ class InferenceClient:
     driver uses.  NOT thread-safe — one instance per thread."""
 
     def __init__(self, endpoint: str, timeout: float = 10.0,
-                 resend_after_s: float = 1.0, max_resends: int = 8):
+                 resend_after_s: float = 1.0, max_resends: int = 8,
+                 deadline_s: Optional[float] = None,
+                 client_id: Optional[str] = None,
+                 breaker_window: int = 16, breaker_failures: int = 8,
+                 breaker_reset_s: float = 0.5,
+                 breaker_backoff_cap_s: float = 30.0):
         import uuid
 
         import zmq
@@ -47,10 +82,33 @@ class InferenceClient:
         #: prefix for this client's trace_ids (ISSUE 5 correlation —
         #: the server echoes them in replies and tags its spans)
         self._tag = uuid.uuid4().hex[:6]
+        #: admission identity shipped as ``client`` metadata (ISSUE 6):
+        #: the server's rate limit / fair queue keys on it
+        self.client_id = client_id or self._tag
         self.endpoint = endpoint
         self.timeout = float(timeout)
         self.resend_after_s = float(resend_after_s)
         self.max_resends = int(max_resends)
+        #: per-request deadline budget shipped on the wire; defaults to
+        #: ``timeout`` (by the client's own deadline the answer is
+        #: worthless anyway); per-call ``deadline_s`` overrides
+        self.deadline_s = (float(timeout) if deadline_s is None
+                           else float(deadline_s))
+        # -- circuit breaker (module docstring); breaker_failures=0
+        # disables it
+        self._brk_outcomes: collections.deque = collections.deque(
+            maxlen=max(int(breaker_window), 1))
+        # clamp: a threshold above the window could never be reached
+        # (count(False) <= maxlen) — the breaker would be silently
+        # disarmed while the operator believes it is armed
+        self._brk_threshold = min(int(breaker_failures),
+                                  self._brk_outcomes.maxlen)
+        self._brk_state = "closed"
+        self._brk_until = 0.0
+        self._brk_backoff0 = float(breaker_reset_s)
+        self._brk_backoff = float(breaker_reset_s)
+        self._brk_cap = float(breaker_backoff_cap_s)
+        self._brk_probe: Optional[int] = None
         # telemetry (ISSUE 5): client-side accounting in the registry;
         # historical attribute names preserved by generated properties
         from znicz_tpu import telemetry
@@ -58,6 +116,11 @@ class InferenceClient:
         _sc = telemetry.scope("serving_client")
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
+        _sc.gauge("breaker_open",
+                  "circuit breaker state (0 closed, 0.5 half-open, 1 open)",
+                  fn=telemetry.weak_fn(
+                      self, lambda c: {"closed": 0.0, "half_open": 0.5,
+                                       "open": 1.0}[c._brk_state]))
         self._ids = itertools.count(1)
         #: req_id -> [frames, t_last_sent, resends]
         self._pending: Dict[int, List] = {}
@@ -74,6 +137,10 @@ class InferenceClient:
         "resends": "re-sent requests (lost/ignored)",
         "bad_replies": "undecodable replies",  # shared family
         "errors": "service refusals received",
+        "give_ups": "requests abandoned at max_resends/timeout",
+        "breaker_opens": "circuit breaker transitions to open",
+        "breaker_short_circuits": "requests refused locally: breaker open",
+        "breaker_probes": "half-open probe requests sent",
     }
 
     # -- pipelined API ---------------------------------------------------------
@@ -92,15 +159,91 @@ class InferenceClient:
         # optional correlation key in the v3 metadata frame (ISSUE 5):
         # old servers ignore it, new ones echo it and tag their spans
         msg.setdefault("trace_id", f"{self._tag}-{rid}")
+        # admission identity (ISSUE 6): keys the server's per-client
+        # rate limit and fair subqueue, proxy-transparent
+        msg.setdefault("client", self.client_id)
         payload, _ = wire.encode_message(msg)
         frames = [b""] + payload
         self._sock.send_multipart(frames, copy=False)
         self._pending[rid] = [frames, time.perf_counter(), 0]
         return rid
 
-    def submit(self, x: np.ndarray) -> int:
-        """Send one inference request; returns its ``req_id``."""
-        return self._send({"cmd": "infer", "x": np.ascontiguousarray(x)})
+    # -- circuit breaker -------------------------------------------------------
+
+    @property
+    def breaker_state(self) -> str:
+        """``closed`` / ``open`` / ``half_open`` (open flips to
+        half_open lazily, at the first post-backoff submit)."""
+        return self._brk_state
+
+    def _breaker_admit(self) -> None:
+        """Submit-side gate: fail fast while open; after the backoff,
+        let exactly ONE probe through (half-open)."""
+        if self._brk_threshold <= 0:
+            return
+        if self._brk_state == "open":
+            now = time.perf_counter()
+            if now < self._brk_until:
+                self._m["breaker_short_circuits"].inc()
+                raise CircuitOpenError(
+                    f"circuit open to {self.endpoint}: "
+                    f"{self._brk_outcomes.count(False)} failures in the "
+                    f"last {len(self._brk_outcomes)} outcomes; next "
+                    f"probe in {self._brk_until - now:.2f}s")
+            self._brk_state = "half_open"
+            self._brk_probe = None
+        if self._brk_state == "half_open" and self._brk_probe is not None:
+            self._m["breaker_short_circuits"].inc()
+            raise CircuitOpenError(
+                f"circuit half-open to {self.endpoint}: probe "
+                f"req {self._brk_probe} still in flight")
+
+    def _breaker_open(self) -> None:
+        self._brk_state = "open"
+        self._brk_until = time.perf_counter() + self._brk_backoff
+        # capped exponential growth, PR 2's reconnect-backoff idiom
+        self._brk_backoff = min(self._brk_backoff * 2, self._brk_cap)
+        self._m["breaker_opens"].inc()
+
+    def _breaker_record(self, rid, ok: bool) -> None:
+        """File one request OUTCOME.  Breaker failures are service-
+        health signals only: give-ups and shed/bad-frame refusals —
+        never per-client refusals (module docstring)."""
+        if self._brk_threshold <= 0:
+            return
+        if self._brk_state == "half_open" and rid == self._brk_probe:
+            self._brk_probe = None
+            if ok:
+                self._brk_state = "closed"
+                self._brk_outcomes.clear()
+                self._brk_backoff = self._brk_backoff0
+            else:
+                self._breaker_open()
+            return
+        self._brk_outcomes.append(bool(ok))
+        if (self._brk_state == "closed"
+                and len(self._brk_outcomes) >= self._brk_threshold
+                and self._brk_outcomes.count(False)
+                >= self._brk_threshold):
+            self._breaker_open()
+
+    def submit(self, x: np.ndarray,
+               deadline_s: Optional[float] = None) -> int:
+        """Send one inference request; returns its ``req_id``.
+        ``deadline_s`` overrides the client's default budget for this
+        request (<= 0: ship no deadline — the server's TTL governs).
+        Raises :class:`CircuitOpenError` without touching the wire
+        while the breaker is open."""
+        self._breaker_admit()
+        msg = {"cmd": "infer", "x": np.ascontiguousarray(x)}
+        budget = self.deadline_s if deadline_s is None else float(deadline_s)
+        if budget > 0:
+            msg["deadline_ms"] = budget * 1e3
+        rid = self._send(msg)
+        if self._brk_state == "half_open" and self._brk_probe is None:
+            self._brk_probe = rid
+            self._m["breaker_probes"].inc()
+        return rid
 
     def _command(self, cmd: str, timeout: Optional[float] = None) -> dict:
         return self.result(self._send({"cmd": cmd}), timeout=timeout)
@@ -111,6 +254,14 @@ class InferenceClient:
     def stats(self, timeout: Optional[float] = None) -> dict:
         """The server's live stats() dict (the serving panel payload)."""
         return self._command("stats", timeout)["stats"]
+
+    def swap(self, path: str, timeout: Optional[float] = None) -> dict:
+        """Trigger a zero-downtime snapshot rollover (ISSUE 6); the
+        reply acknowledges the START (``swap_started`` + the still-live
+        generation) — poll ``stats()["generation"]`` for the flip.
+        Control command: bypasses the breaker, like ping/stats."""
+        return self.result(self._send({"cmd": "swap", "path": path}),
+                           timeout=timeout)
 
     def _pump(self, wait_s: float) -> None:
         """Receive every reply available (waiting up to ``wait_s`` for
@@ -142,19 +293,51 @@ class InferenceClient:
             if rid in self._pending:
                 del self._pending[rid]
                 self._results[rid] = rep
+                # breaker outcome: ok replies and PER-CLIENT refusals
+                # count as healthy; only a SERVICE-scoped shed (global
+                # queue at bound) means the service itself is
+                # overloaded — a client-scoped shed (this caller's own
+                # fair-share bound) is the caller's problem (module
+                # docstring)
+                self._breaker_record(
+                    rid, bool(rep.get("ok"))
+                    or rep.get("policy") != "shed"
+                    or rep.get("scope") == "client")
+            elif rep.get("bad_frame"):
+                # the service could not decode one of OUR requests
+                # (corrupted in flight): a service-path failure for the
+                # breaker window.  The refusal carries no req_id, so it
+                # clears no pending entry — the resend timer re-ships
+                # the same bytes
+                self._breaker_record(None, False)
             # else: duplicate (our resend raced the original) — dropped
 
     def _maybe_resend(self) -> None:
         now = time.perf_counter()
-        for rid, entry in self._pending.items():
+        for rid, entry in list(self._pending.items()):
             frames, t_sent, n = entry
             if now - t_sent < self.resend_after_s:
                 continue
             if n >= self.max_resends:
-                raise TimeoutError(
-                    f"req {rid}: no reply after {n} resends over "
-                    f"{now - t_sent + n * self.resend_after_s:.1f}s — "
-                    f"service at {self.endpoint} unreachable?")
+                # capped resend loop (ISSUE 6 satellite): abandon the
+                # request with a counted, readable give-up — the master
+                # client's connect_retries fail-fast, mirrored.  Filed
+                # as the request's OWN (synthetic) reply, not raised:
+                # this runs inside whatever result()/collect() call
+                # happened to be pumping, and raising here would
+                # misattribute request A's death to a caller waiting
+                # on request B (and silently lose A's outcome)
+                del self._pending[rid]
+                self._m["give_ups"].inc()
+                self._breaker_record(rid, False)
+                self._results[rid] = {
+                    "ok": False, "gave_up": True, "req_id": rid,
+                    "error": f"req {rid}: no reply after {n} resends "
+                             f"over {now - t_sent + n * self.resend_after_s:.1f}s "
+                             f"— giving up (max_resends="
+                             f"{self.max_resends}); service at "
+                             f"{self.endpoint} unreachable?"}
+                continue
             # the SAME encoded frames: bytes, not re-serialization
             self._sock.send_multipart(frames, copy=False)
             entry[1] = now
@@ -169,11 +352,18 @@ class InferenceClient:
                                           else float(timeout))
         while req_id not in self._results:
             if time.perf_counter() > deadline:
+                self._pending.pop(req_id, None)
+                self._m["give_ups"].inc()
+                self._breaker_record(req_id, False)
                 raise TimeoutError(f"req {req_id}: no reply within "
                                    f"{self.timeout:g}s")
             self._pump(0.05)
             self._maybe_resend()
         rep = self._results.pop(req_id)
+        if rep.get("gave_up"):
+            # THIS request's capped-resend give-up (synthetic reply
+            # from _maybe_resend) — still a timeout to the caller
+            raise TimeoutError(str(rep.get("error")))
         if not rep.get("ok"):
             self._m["errors"].inc()
             raise InferenceError(rep)
@@ -194,12 +384,13 @@ class InferenceClient:
 
     # -- synchronous API -------------------------------------------------------
 
-    def infer(self, x: np.ndarray,
-              timeout: Optional[float] = None) -> np.ndarray:
+    def infer(self, x: np.ndarray, timeout: Optional[float] = None,
+              deadline_s: Optional[float] = None) -> np.ndarray:
         """One request, one result: the (n, *out) result rows for the
         (n, *sample) input (a bare sample comes back with its leading
         1-row axis)."""
-        return self.result(self.submit(x), timeout=timeout)["y"]
+        return self.result(self.submit(x, deadline_s=deadline_s),
+                           timeout=timeout)["y"]
 
     def close(self) -> None:
         self._sock.close(0)

@@ -23,20 +23,26 @@ Threading:
     result, so staging of batch N+1 overlaps compute of batch N (the
     ``loader/ingest.py`` overlap discipline).
 
-Fault model (README "Serving"): an undecodable or corrupted request
-frame is refused with an error reply and counted, never fatal; a
-request that would overflow the bounded queue is shed immediately with
-a readable reason; a request older than ``request_ttl_s`` by the time
-its batch closes is answered ``timed_out`` instead of computed.  The
-service survives a ChaosProxy soak (tests/test_serving.py).
+Fault model (README "Serving" + "Serving robustness"): an undecodable
+or corrupted request frame is refused with an error reply and counted,
+never fatal; every ADMISSION refusal (shed / oversized / rate_limited /
+deadline) is answered with a readable reason AND the ``policy`` slug
+that refused it; a request whose deadline (client-shipped budget, else
+``request_ttl_s``) passes is answered ``timed_out`` at assemble time —
+and a computed result that misses the deadline is dropped, never
+shipped.  The service survives a ChaosProxy soak (tests/
+test_serving.py) and swaps snapshots live (``swap`` control command /
+SIGHUP) without losing a single accepted request.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -45,19 +51,45 @@ from znicz_tpu.core.config import root
 
 from znicz_tpu.telemetry.metrics import registered_property
 
-from .batcher import BucketLadder, DynamicBatcher, Request
+from .batcher import (AdmissionPolicy, BucketLadder, DynamicBatcher,
+                      Request)
 from .model import ModelRunner
 
 #: serving config home: ``root.common.serving.*`` (CLI dotted overrides
-#: reach it like every other knob)
+#: reach it like every other knob).  EVERY ``root.common.serving.*``
+#: key the codebase reads must appear here — tests/
+#: test_no_adhoc_counters.py lints for silently-ignored config.
 DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
-            "request_ttl_s": 5.0}
+            "request_ttl_s": 5.0, "max_requests": None, "web_port": None,
+            "admission": {"enabled": True, "rate_limit": 0.0,
+                          "rate_burst": 0.0, "fair": True, "quantum": 0,
+                          "client_queue_bound": 0}}
 
 
 def _cfg(name: str, override):
     if override is not None:
         return override
     return root.common.serving.get(name, DEFAULTS[name])
+
+
+def _admission_from_config() -> AdmissionPolicy:
+    # every read spells the LITERAL root.common.serving.admission chain:
+    # the config-knob lint (tests/test_no_adhoc_counters.py) matches
+    # these chains textually, and binding the subtree to a variable
+    # would hide the key reads from it
+    d = DEFAULTS["admission"]
+    return AdmissionPolicy(
+        rate_limit=float(root.common.serving.admission.get(
+            "rate_limit", d["rate_limit"])),
+        rate_burst=float(root.common.serving.admission.get(
+            "rate_burst", d["rate_burst"])),
+        fair=bool(root.common.serving.admission.get("fair", d["fair"])),
+        quantum=int(root.common.serving.admission.get(
+            "quantum", d["quantum"])),
+        client_queue_bound=int(root.common.serving.admission.get(
+            "client_queue_bound", d["client_queue_bound"])),
+        enabled=bool(root.common.serving.admission.get(
+            "enabled", d["enabled"])))
 
 
 class InferenceServer:
@@ -76,6 +108,7 @@ class InferenceServer:
                  request_ttl_s: Optional[float] = None,
                  ladder: Optional[BucketLadder] = None,
                  max_requests: Optional[int] = None,
+                 admission: Optional[AdmissionPolicy] = None,
                  warmup: bool = True):
         from znicz_tpu.parallel import wire
 
@@ -87,7 +120,8 @@ class InferenceServer:
             max_batch=max_batch,
             max_delay_ms=float(_cfg("max_delay_ms", max_delay_ms)),
             queue_bound=int(_cfg("queue_bound", queue_bound)),
-            ladder=ladder)
+            ladder=ladder,
+            admission=admission or _admission_from_config())
         self.request_ttl_s = float(_cfg("request_ttl_s", request_ttl_s))
         self.max_requests = max_requests
         self._warmup = warmup
@@ -112,6 +146,8 @@ class InferenceServer:
         self._serve_error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._compute_thread: Optional[threading.Thread] = None
+        self._swap_thread: Optional[threading.Thread] = None
+        self._swap_gate = threading.Lock()  # one swap_async admit at a time
         self.log = logging.getLogger("znicz.serving")
 
     # -- counters shorthand ----------------------------------------------------
@@ -121,8 +157,11 @@ class InferenceServer:
     COUNTERS = {
         "requests_in": "decoded infer requests",
         "served": "answered with a result",
-        "timed_out": "answered timed_out (TTL)",
-        "rejected": "answered shed/oversized",
+        "timed_out": "answered timed_out (deadline/TTL)",
+        "rejected": "answered shed/oversized/rate_limited",
+        "expired_results": "computed results dropped: deadline passed "
+                           "post-compute",
+        "serve_errors": "fatal serve-loop failures surfaced to start()",
     }
 
     # (the historical attribute properties are generated from COUNTERS
@@ -154,6 +193,10 @@ class InferenceServer:
                "served": self.served,
                "rejected": self.rejected,
                "timed_out": self.timed_out,
+               "expired_results": self.expired_results,
+               "ready": self.ready(),
+               "draining": self.draining,
+               "generation": self.runner.generation,
                "bad_frames": self.codec.bad_frames,
                "bytes_in": self.codec.bytes_in,
                "bytes_out": self.codec.bytes_out,
@@ -194,6 +237,60 @@ class InferenceServer:
             self._thread.join(timeout=30)
             self._thread = None
 
+    # -- health/readiness + rollover (ISSUE 6) ---------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True once stop() (or a fatal serve error) began winding the
+        service down — queued work still drains, new work is refused."""
+        return self._stop.is_set()
+
+    def alive(self) -> bool:
+        """Liveness (the ``/healthz`` answer): the serve loop has not
+        died on an error and its thread (when ``start()``-driven) is
+        still running."""
+        return self._serve_error is None and (
+            self._thread is None or self._thread.is_alive())
+
+    def ready(self) -> bool:
+        """Readiness (the ``/readyz`` answer): up, not draining, and
+        not mid-rollover — False exactly while warming or draining, the
+        membership signal a replica tier's health checks need."""
+        return (self._ready.is_set() and self._serve_error is None
+                and not self._stop.is_set() and not self.runner.swapping)
+
+    def swap_async(self, path: str) -> threading.Thread:
+        """Start a zero-downtime snapshot rollover on a background
+        thread (the wire ``swap`` command and the launcher's SIGHUP
+        land here); serving continues on the old generation until the
+        warmed flip.  Raises RuntimeError while another swap runs —
+        atomically: the wire command (router thread) and SIGHUP (main
+        thread) can race here, and a check-then-start race would ack
+        both callers while one swap dies in the background."""
+        with self._swap_gate:
+            if (self._swap_thread is not None
+                    and self._swap_thread.is_alive()):
+                raise RuntimeError("swap already in progress")
+            t = threading.Thread(target=self._swap, args=(path,),
+                                 daemon=True, name="znicz-swap")
+            self._swap_thread = t
+            t.start()
+        return t
+
+    def _swap(self, path: str) -> None:
+        try:
+            meta = self.runner.swap(path, self.batcher.ladder)
+            self.log.info("snapshot rollover -> generation %d (%s, "
+                          "epoch %s)", self.runner.generation, path,
+                          meta.get("epoch"))
+        except Exception:
+            # counted by the runner (swap_failures); the old generation
+            # keeps serving — a broken snapshot must never take the
+            # service down
+            self.log.exception(
+                "snapshot swap from %r failed; generation %d unchanged",
+                path, self.runner.generation)
+
     # -- the ROUTER loop -------------------------------------------------------
 
     def serve(self) -> None:
@@ -204,6 +301,7 @@ class InferenceServer:
             self._serve()
         except BaseException as exc:
             self._serve_error = exc
+            self._m["serve_errors"].inc()
             raise
         finally:
             self._ready.set()
@@ -316,6 +414,26 @@ class InferenceServer:
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": True, "stats": self.stats(), "req_id": rid}))
             return
+        if cmd == "swap":
+            # zero-downtime rollover trigger (ISSUE 6): load+warm runs
+            # on a background thread, this reply ships immediately; the
+            # caller polls stats()["generation"] for completion
+            path = req.get("path")
+            if not isinstance(path, str) or not path:
+                sock.send_multipart(list(envelope) + self.codec.encode(
+                    {"ok": False, "req_id": rid,
+                     "error": "swap needs a snapshot 'path'"}))
+                return
+            try:
+                self.swap_async(path)
+            except RuntimeError as exc:
+                sock.send_multipart(list(envelope) + self.codec.encode(
+                    {"ok": False, "req_id": rid, "error": str(exc)}))
+                return
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": True, "swap_started": True, "req_id": rid,
+                 "generation": self.runner.generation}))
+            return
         if cmd != "infer":
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
@@ -348,32 +466,72 @@ class InferenceServer:
                           f"{self.runner.dtype}"}))
             return
         self._m["requests_in"].inc()
+        # admission identity: explicit ``client`` metadata when the
+        # peer ships one (the InferenceClient does), else a digest of
+        # the ROUTER envelope — still distinct per client through a
+        # proxy, because the client's own identity frame rides inside
+        client = req.get("client")
+        if not isinstance(client, str) or not client:
+            client = "peer-%08x" % (zlib.crc32(
+                b"".join(bytes(f) for f in envelope)) & 0xFFFFFFFF)
+        # deadline ingress (ISSUE 6): the client's shipped budget
+        # becomes a LOCAL absolute deadline here (budgets, not
+        # timestamps, cross the wire — clocks differ); the server's
+        # request_ttl_s stays the cap.  Re-checked at assemble time and
+        # post-compute: expired work is never computed, never shipped.
+        deadline_s = self.request_ttl_s
+        budget_ms = req.get("deadline_ms")
+        if budget_ms is not None:
+            try:
+                budget_s = float(budget_ms) / 1e3
+            except (TypeError, ValueError):
+                budget_s = float("nan")
+            # non-finite budgets are garbage too: min(nan, ttl) is nan,
+            # and a nan deadline fails every later expiry check — a
+            # client could disable the TTL outright with one bad float
+            if math.isfinite(budget_s):
+                deadline_s = min(budget_s, deadline_s)
+        if deadline_s <= 0:
+            self._m["timed_out"].inc()
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "timed_out": True, "req_id": rid,
+                 "policy": "deadline", "trace_id": req.get("trace_id"),
+                 "error": f"deadline budget {budget_ms}ms already "
+                          f"expended — refused at ingress"}))
+            return
         reason = self.batcher.submit(
             Request(x, x.shape[0], reply_to=list(envelope), req_id=rid,
-                    trace_id=req.get("trace_id")))
+                    trace_id=req.get("trace_id"), client=client,
+                    deadline_s=deadline_s))
         if reason is not None:
             self._m["rejected"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "rejected": True, "req_id": rid,
-                 "trace_id": req.get("trace_id"), "error": reason}))
+                 "policy": getattr(reason, "policy", "refused"),
+                 "scope": getattr(reason, "scope", "service"),
+                 "trace_id": req.get("trace_id"), "error": str(reason)}))
 
     # -- the compute loop (donated ping-pong) ----------------------------------
 
     def _assemble(self, batch: List[Request]):
         """Coalesced requests -> (live requests, staged device buffer).
-        TTL-expired requests are answered ``timed_out`` here — computing
-        them would waste a batch slot on an answer nobody is waiting
-        for.  Returns None when the whole batch expired."""
+        Deadline-expired requests (client budget, else the TTL) are
+        answered ``timed_out`` here — computing them would waste a
+        batch slot on an answer nobody is waiting for.  Returns None
+        when the whole batch expired."""
         now = time.perf_counter()
         live = []
         for r in batch:
-            if now - r.t_enqueued > self.request_ttl_s:
+            deadline = (r.t_enqueued + self.request_ttl_s
+                        if r.t_deadline is None else r.t_deadline)
+            if now > deadline:
                 self._m["timed_out"].inc()
                 self._outbound.put((r.reply_to, {
                     "ok": False, "timed_out": True, "req_id": r.req_id,
-                    "trace_id": r.trace_id,
-                    "error": f"request waited past request_ttl_s="
-                             f"{self.request_ttl_s:g}"}, None))
+                    "policy": "deadline", "trace_id": r.trace_id,
+                    "error": f"request expired before compute (deadline "
+                             f"budget spent queueing; ttl cap "
+                             f"{self.request_ttl_s:g}s)"}, None))
                 continue
             live.append(r)
         if not live:
@@ -392,7 +550,7 @@ class InferenceServer:
             staged = self.runner.stage(x)
         return live, staged
 
-    def _finish(self, live: List[Request], y_dev,
+    def _finish(self, live: List[Request], y_dev, gen: int,
                 t_dispatch: Optional[float] = None) -> None:
         y = np.asarray(y_dev)               # the sync point
         if t_dispatch is not None and self._tracer.enabled:
@@ -403,12 +561,31 @@ class InferenceServer:
                 time.perf_counter() - t_dispatch,
                 {"rows": sum(r.n for r in live), "requests": len(live),
                  "trace_id": live[0].trace_id if live else None})
+        now = time.perf_counter()
         off = 0
         for r in live:
+            if r.t_deadline is not None and now > r.t_deadline:
+                # the post-compute deadline check: a late result is
+                # DROPPED, never shipped — the client already moved on,
+                # and shipping it would spend reply bandwidth on an
+                # answer nobody is waiting for
+                self._m["timed_out"].inc()
+                self._m["expired_results"].inc()
+                self._outbound.put((r.reply_to, {
+                    "ok": False, "timed_out": True, "req_id": r.req_id,
+                    "policy": "deadline", "trace_id": r.trace_id,
+                    "error": "result ready past the deadline — dropped, "
+                             "not shipped"}, None))
+                off += r.n
+                continue
             # slice-copy: each reply owns its rows (the padded tail is
-            # dropped here — pad rows never leave the server)
+            # dropped here — pad rows never leave the server).  ``gen``
+            # names the snapshot generation that answered — ONE per
+            # batch by construction (the runner reads (params, gen)
+            # atomically), the rollover proof's per-reply assertion.
             self._outbound.put((r.reply_to, {
                 "ok": True, "req_id": r.req_id, "trace_id": r.trace_id,
+                "gen": gen,
                 "y": np.array(y[off:off + r.n])}, r.t_enqueued))
             off += r.n
             self._m["served"].inc()
@@ -443,7 +620,7 @@ class InferenceServer:
                 # dispatch is async; the staged buffer is DONATED into
                 # the step (ping-pong half 1)
                 t_dispatch = time.perf_counter()
-                y_dev = self.runner.infer_staged(x_dev)
+                y_dev, gen = self.runner.infer_staged(x_dev)
                 staged = None
                 # while the device computes batch N, grab-and-stage what
                 # is ALREADY queued as batch N+1 (ping-pong half 2: at
@@ -454,7 +631,7 @@ class InferenceServer:
                                               wait_fill=False)
                 if nxt is not None:
                     staged = self._assemble(nxt)
-                self._finish(live, y_dev, t_dispatch)
+                self._finish(live, y_dev, gen, t_dispatch)
                 poke()                  # replies queued: wake the router
         except Exception:
             # a compute-thread death must not strand clients silently

@@ -26,10 +26,20 @@ the one the device is consuming (its memory reusable for activations
 the instant the gather reads it) and the one the next batch is staging
 into.  The frontend's compute loop overlaps stage(N+1) with compute(N),
 the same overlap discipline as ``loader/ingest.py``'s prefetch.
+
+**Zero-downtime snapshot rollover** (ISSUE 6): :meth:`swap` loads a new
+snapshot's params, bucket-warms them through every ladder rung, then
+flips ``(params, generation)`` as ONE atomic tuple — serving continues
+on the old generation throughout, and because every dispatch reads the
+tuple exactly once, every request is answered entirely by one snapshot
+generation (the ``gen`` id in each reply proves it).  A failed load or
+warm leaves the served generation untouched.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -65,7 +75,18 @@ class ModelRunner:
             snapshotter.load_inference(workflow, snapshot)
         self.workflow = workflow
         self._trainer = FusedTrainer(workflow)
-        self.params = self._trainer.extract_params()
+        #: (params tree, generation id) — read ONCE per dispatch, flipped
+        #: as one tuple by swap(): per-request snapshot atomicity
+        self._active = (self._trainer.extract_params(), 1)
+        self._swap_lock = threading.Lock()  # one rollover at a time
+        #: True while swap() loads/warms (the /readyz "warming" signal)
+        self.swapping = False
+        self._dispatch_no = 0               # compute-fault stream cursor
+        self._dispatch_lock = threading.Lock()  # cursor is shared by the
+        #                                     compute thread AND swap()'s
+        #                                     warmup dispatches
+        self._chaos = None                  # FaultSchedule, or None
+        self._m_stalls = None
         #: per-sample input shape the service accepts (requests carry
         #: (n, *sample_shape) arrays)
         self.sample_shape: Tuple[int, ...] = tuple(
@@ -82,7 +103,14 @@ class ModelRunner:
         #: ``compiles`` property preserves the historical name)
         self._m = {"compiles": _sc.counter(
             "compiles",
-            "traces of the jitted forward == jit cache entries")}
+            "traces of the jitted forward == jit cache entries"),
+            "swaps": _sc.counter(
+                "swaps", "completed snapshot rollovers"),
+            "swap_failures": _sc.counter(
+                "swap_failures",
+                "rollovers refused/failed (old generation kept serving)")}
+        _sc.gauge("generation", "live snapshot generation id",
+                  fn=telemetry.weak_fn(self, lambda r: r.generation))
         compiles = self._m["compiles"]
         key = self._trainer._key0       # eval path never consumes it
 
@@ -104,6 +132,21 @@ class ModelRunner:
 
     compiles = registered_property(
         "compiles", "traces of the jitted forward == jit cache entries")
+    swaps = registered_property(
+        "swaps", "completed snapshot rollovers")
+    swap_failures = registered_property(
+        "swap_failures", "rollovers refused/failed")
+
+    @property
+    def params(self):
+        """The LIVE generation's params tree (historical attribute)."""
+        return self._active[0]
+
+    @property
+    def generation(self) -> int:
+        """Snapshot generation id stamped on every reply; bumps on each
+        completed :meth:`swap`."""
+        return self._active[1]
 
     # -- the two halves of the ping-pong ---------------------------------------
 
@@ -115,19 +158,55 @@ class ModelRunner:
 
         return jax.device_put(np.ascontiguousarray(x, self.dtype))
 
-    def infer_staged(self, x_dev):
+    def _maybe_stall(self) -> None:
+        """Chaos compute-fault hook (ISSUE 6): one ``decide_compute``
+        decision per dispatch; a ``stall`` sleeps here — the seeded
+        slow-compute fault the rollover/fairness soaks run under.  The
+        cursor advances under a lock: during a swap the background
+        warmup dispatches race the compute thread, and a lost increment
+        would let two dispatches replay one stream index."""
+        with self._dispatch_lock:
+            no = self._dispatch_no
+            self._dispatch_no += 1
+            chaos = self._chaos
+        if chaos is None:
+            return
+        action, s = chaos.decide_compute(no)
+        if action == "stall":
+            self._m_stalls.inc()
+            time.sleep(s)
+
+    def infer_staged(self, x_dev) -> Tuple[object, int]:
         """Dispatch the forward on an already-staged (device) batch and
-        return the un-materialized device result.  ``x_dev`` is DONATED
-        (where the backend supports donation — see ``donate``); callers
-        must not reuse it after this call either way."""
-        return self._fwd(self.params, x_dev)
+        return ``(un-materialized device result, generation id)`` —
+        params and generation are read as one tuple, so the whole batch
+        is answered by exactly one snapshot generation.  ``x_dev`` is
+        DONATED (where the backend supports donation — see ``donate``);
+        callers must not reuse it after this call either way."""
+        self._maybe_stall()
+        params, gen = self._active
+        return self._fwd(params, x_dev), gen
+
+    def inject_compute_faults(self, schedule) -> None:
+        """Arm the seeded compute-fault hook: ``schedule`` (a chaos
+        ``FaultSchedule``) decides per dispatch whether this runner
+        stalls (``decide_compute``); counted in the chaos fault family
+        like the proxy's wire faults."""
+        from znicz_tpu import telemetry
+
+        if self._m_stalls is None:
+            self._m_stalls = telemetry.scope("chaos").counter(
+                "faults", "injected proxy fault decisions",
+                direction="compute", action="stall")
+        self._chaos = schedule
 
     # -- conveniences ----------------------------------------------------------
 
     def infer(self, x: np.ndarray) -> np.ndarray:
         """Synchronous forward of one host batch (tests, warmup, the
         sequential baseline)."""
-        return np.asarray(self.infer_staged(self.stage(x)))
+        y_dev, _ = self.infer_staged(self.stage(x))
+        return np.asarray(y_dev)
 
     def pad(self, x: np.ndarray, bucket: int) -> np.ndarray:
         """Zero-pad a (n, *sample) batch up to ``bucket`` rows.  The
@@ -148,6 +227,46 @@ class ModelRunner:
             self.infer(np.zeros((rung,) + self.sample_shape, self.dtype))
         return self.compiles
 
+    def swap(self, path: str, ladder=None) -> Dict:
+        """Zero-downtime snapshot rollover (ISSUE 6): load ``path``
+        through the inference path, bucket-warm the NEW params through
+        every ``ladder`` rung, then flip ``(params, generation)``
+        atomically.  Runs on the CALLING thread (the frontend drives it
+        from a background thread); dispatches keep serving the OLD
+        generation until the flip, so no request is lost and none mixes
+        generations.  Warming costs no recompiles — the new tree has
+        the same shapes/dtypes, so every rung is a jit cache hit; it
+        pre-pays device transfer and catches a broken snapshot while
+        the old generation still serves.  A second concurrent swap, a
+        non-covering snapshot, or a warm failure raises and leaves the
+        live generation untouched (``swap_failures`` counts it).
+        Returns the snapshot's metadata."""
+        import jax
+
+        from znicz_tpu import snapshotter
+
+        if not self._swap_lock.acquire(blocking=False):
+            self._m["swap_failures"].inc()
+            raise RuntimeError("swap already in progress")
+        try:
+            self.swapping = True
+            try:
+                meta = snapshotter.load_inference(self.workflow, path)
+                params = self._trainer.extract_params()
+                for rung in (ladder or ()):
+                    self._maybe_stall()
+                    x = np.zeros((rung,) + self.sample_shape, self.dtype)
+                    np.asarray(self._fwd(params, jax.device_put(x)))
+                self._active = (params, self.generation + 1)
+                self._m["swaps"].inc()
+                return meta
+            except Exception:
+                self._m["swap_failures"].inc()
+                raise
+        finally:
+            self.swapping = False
+            self._swap_lock.release()
+
     def jit_cache_size(self) -> Optional[int]:
         """jax's own executable-cache entry count for the jitted forward
         (the jax._src pjit cache behind ``_cache_size``); None where the
@@ -161,5 +280,9 @@ class ModelRunner:
     def stats(self) -> Dict:
         return {"compiles": self.compiles,
                 "jit_cache_size": self.jit_cache_size(),
+                "generation": self.generation,
+                "swapping": self.swapping,
+                "swaps": self.swaps,
+                "swap_failures": self.swap_failures,
                 "sample_shape": list(self.sample_shape),
                 "dtype": str(self.dtype)}

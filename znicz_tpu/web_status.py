@@ -12,8 +12,12 @@ and the device mesh — over a tiny stdlib ThreadingHTTPServer:
 
 Endpoints: ``/`` (HTML page, auto-refresh), ``/status.json``,
 ``/metrics`` (Prometheus text exposition of the process-wide telemetry
-registry — ISSUE 5) and ``/trace.json`` (the telemetry span ring as
-Chrome trace-event JSON; open it in Perfetto).
+registry — ISSUE 5), ``/trace.json`` (the telemetry span ring as
+Chrome trace-event JSON; open it in Perfetto), and — for a registered
+inference service (ISSUE 6) — ``/healthz`` (liveness: 200 while the
+serve loop runs, 503 once it died) and ``/readyz`` (readiness: 503
+while warming a snapshot rollover or draining — the membership signal
+the future replica tier's health checks key on).
 
 Lock discipline (ISSUE 5 de-flake satellite): the ``/metrics`` and
 ``/trace.json`` handlers SNAPSHOT the registry/ring into a plain
@@ -40,6 +44,7 @@ class WebStatus:
         self.workflows: List[object] = []
         self.server = None                  # optional master (topology)
         self.inference = None               # optional inference service
+        self.inference_client = None        # optional breaker-side view
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -54,8 +59,15 @@ class WebStatus:
     def register_inference(self, server) -> None:
         """Show the inference service's serving panel (ISSUE 4): qps,
         latency quantiles, batch occupancy, queue depth, per-bucket hit
-        counts, shed/timed-out/bad-frame accounting."""
+        counts, shed/timed-out/bad-frame accounting — plus (ISSUE 6)
+        readiness/generation and the per-client admission table; also
+        arms ``/healthz`` and ``/readyz``."""
         self.inference = server
+
+    def register_inference_client(self, client) -> None:
+        """Show a local InferenceClient's view (ISSUE 6): circuit-
+        breaker state, resends/give-ups, in-flight depth."""
+        self.inference_client = client
 
     # -- snapshotting the state (host side, lock-free reads) -------------------
 
@@ -145,7 +157,51 @@ class WebStatus:
             # stats() assembles from plain counters — safe to call from
             # this HTTP thread while the service runs
             out["serving"] = self.inference.stats()
+        if self.inference_client is not None:
+            c = self.inference_client
+            out["serving_client"] = {
+                "endpoint": c.endpoint,
+                "breaker": c.breaker_state,
+                "in_flight": c.in_flight,
+                "resends": c.resends,
+                "give_ups": c.give_ups,
+                "errors": c.errors,
+                "bad_replies": c.bad_replies,
+                "breaker_opens": c.breaker_opens,
+                "breaker_short_circuits": c.breaker_short_circuits,
+            }
         return out
+
+    def health(self) -> dict:
+        """The ``/healthz`` body: liveness of the registered inference
+        service (no service registered = the process itself answers,
+        which is liveness enough)."""
+        inf = self.inference
+        alive = True if inf is None else bool(inf.alive())
+        return {"ok": alive}
+
+    def readiness(self) -> dict:
+        """The ``/readyz`` body: ready iff a registered inference
+        service is up, warmed, not mid-rollover and not draining."""
+        inf = self.inference
+        if inf is None:
+            return {"ready": False,
+                    "reason": "no inference service registered"}
+        if inf.ready():
+            return {"ready": True, "reason": "ok",
+                    "generation": inf.runner.generation}
+        if not inf.alive():
+            # a crashed loop must not masquerade as "starting": an
+            # operator would wait out a warmup that never ends
+            reason = "dead (serve loop exited — see /healthz)"
+        elif inf.draining:
+            reason = "draining"
+        elif inf.runner.swapping:
+            reason = "warming (snapshot rollover in progress)"
+        else:
+            reason = "starting (warmup in progress)"
+        return {"ready": False, "reason": reason,
+                "generation": inf.runner.generation}
 
     # -- server ----------------------------------------------------------------
 
@@ -157,7 +213,22 @@ class WebStatus:
                 pass
 
             def do_GET(self):
-                if self.path.startswith("/status.json"):
+                code = 200
+                if self.path.startswith("/healthz"):
+                    # liveness (ISSUE 6): 503 tells a supervisor to
+                    # restart the process
+                    health = status.health()
+                    code = 200 if health["ok"] else 503
+                    body = json.dumps(health).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/readyz"):
+                    # readiness: 503 while warming/draining pulls this
+                    # replica out of a load balancer WITHOUT killing it
+                    ready = status.readiness()
+                    code = 200 if ready["ready"] else 503
+                    body = json.dumps(ready).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/status.json"):
                     body = json.dumps(status.snapshot()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
@@ -219,18 +290,34 @@ class WebStatus:
                     if serving:
                         b = serving["batcher"]
                         m = serving["model"]
+                        adm = b.get("admission", {})
                         brows = "".join(
                             f"<tr><td>{r}</td><td>{n}</td></tr>"
                             for r, n in sorted(b["bucket_hits"].items()))
+                        state = ("DRAINING" if serving.get("draining")
+                                 else "ready" if serving.get("ready")
+                                 else "warming")
+                        crows = "".join(
+                            f"<tr><td>{html.escape(cid)}</td>"
+                            f"<td>{c['accepted']}</td>"
+                            f"<td>{c['rate_limited']}</td>"
+                            f"<td>{c['shed']}</td></tr>"
+                            for cid, c in sorted(
+                                adm.get("clients", {}).items()))
                         serving_html = (
                             "<h2>Serving "
                             f"{html.escape(str(serving['endpoint']))}</h2>"
+                            f"<p>state: {state}, snapshot generation: "
+                            f"{serving['generation']}"
+                            f"{' (swapping)' if m.get('swapping') else ''}"
+                            f", swaps: {m.get('swaps')}</p>"
                             f"<p>qps: {serving['qps']}, p50: "
                             f"{serving['p50_ms']} ms, p99: "
                             f"{serving['p99_ms']} ms, served: "
                             f"{serving['served']}, rejected: "
                             f"{serving['rejected']}, timed out: "
-                            f"{serving['timed_out']}, bad frames: "
+                            f"{serving['timed_out']}, expired results: "
+                            f"{serving['expired_results']}, bad frames: "
                             f"{serving['bad_frames']}</p>"
                             f"<p>batcher: occupancy "
                             f"{b['mean_occupancy']}, queue depth "
@@ -240,8 +327,27 @@ class WebStatus:
                             f"{b['max_delay_ms']} ms; jit compiles "
                             f"{m['compiles']} (cache "
                             f"{m['jit_cache_size']})</p>"
+                            f"<p>admission: "
+                            f"{'on' if adm.get('enabled') else 'off'}, "
+                            f"rate limit "
+                            f"{adm.get('rate_limit_rows_per_s')} rows/s, "
+                            f"fair: {adm.get('fair')}, rate_limited: "
+                            f"{adm.get('rate_limited')}, active clients: "
+                            f"{adm.get('active_clients')}</p>"
+                            "<table border=1><tr><th>client</th>"
+                            "<th>accepted</th><th>rate_limited</th>"
+                            f"<th>shed</th></tr>{crows}</table>"
                             "<table border=1><tr><th>bucket</th>"
                             f"<th>hits</th></tr>{brows}</table>")
+                    cli = snap.get("serving_client")
+                    if cli:
+                        serving_html += (
+                            f"<p>client breaker: {cli['breaker']}, "
+                            f"in flight: {cli['in_flight']}, resends: "
+                            f"{cli['resends']}, give-ups: "
+                            f"{cli['give_ups']}, opens: "
+                            f"{cli['breaker_opens']}, short-circuits: "
+                            f"{cli['breaker_short_circuits']}</p>")
                     devs = snap["devices"]
                     dev_text = (f"unavailable — {devs['error']}"
                                 if isinstance(devs, dict)
@@ -256,10 +362,12 @@ class WebStatus:
                         f"{master_html}{serving_html}"
                         "<p><a href='/metrics'>/metrics</a> "
                         "<a href='/trace.json'>/trace.json</a> "
-                        "<a href='/status.json'>/status.json</a></p>"
+                        "<a href='/status.json'>/status.json</a> "
+                        "<a href='/healthz'>/healthz</a> "
+                        "<a href='/readyz'>/readyz</a></p>"
                         "</body></html>").encode()
                     ctype = "text/html"
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
