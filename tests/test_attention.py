@@ -34,10 +34,9 @@ def test_attention_matches_numpy(causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_exact_over_8_shards(causal):
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from znicz_tpu.parallel.mesh import make_mesh
+    from znicz_tpu.parallel.mesh import make_mesh, shard_map
 
     mesh = make_mesh(axes=("sp",))
     n = mesh.shape["sp"]
@@ -107,11 +106,10 @@ def test_sequence_parallel_training_grads_match_and_learn():
     computation exactly, and a few SGD steps reduce the loss."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from znicz_tpu.ops.attention import attention, ring_attention
-    from znicz_tpu.parallel.mesh import make_mesh
+    from znicz_tpu.parallel.mesh import make_mesh, shard_map
 
     B, T, H, D, E = 2, 32, 2, 8, 16
     rng = np.random.default_rng(11)

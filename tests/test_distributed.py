@@ -19,7 +19,8 @@ WORKER = textwrap.dedent("""\
     # verify=False: the count check would initialize the backend, which
     # must not happen before jax.distributed.initialize
     provision_cpu_devices(1, verify=False)
-    from znicz_tpu.parallel.mesh import distributed_init, make_mesh
+    from znicz_tpu.parallel.mesh import (distributed_init, make_mesh,
+                                         shard_map)
 
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     distributed_init(coordinator=f"127.0.0.1:{port}",
@@ -27,7 +28,6 @@ WORKER = textwrap.dedent("""\
     import numpy as np
 
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     assert jax.process_count() == n, jax.process_count()

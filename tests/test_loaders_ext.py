@@ -194,7 +194,10 @@ def test_zmq_loader():
                "class": TRAIN, "size": 2, "last": True}
         sock.send(pickle.dumps(rec))
         sock.send(pickle.dumps({"end": True}))
-        sock.close(0)
+        # linger: a PUSH connect is async and close(0) DROPS queued
+        # messages that raced the TCP handshake — on a loaded host the
+        # feeder would vanish before its two records ever hit the wire
+        sock.close(30_000)
 
     t = threading.Thread(target=feeder)
     t.start()

@@ -153,75 +153,75 @@ def test_anchor_bands_enforced():
 
 
 def test_async_snapshot_does_not_stall_training_cpu():
-    """VERDICT r4 item 4 gate, on hardware where the device->host pull is
-    a memcpy (the CPU backend): a fused run with the snapshotter ACTIVE
-    and saving EVERY epoch (interval=1, on-best too) must not COLLAPSE
-    relative to the gated-off run — the background writer, not the
-    training loop, absorbs the save cost.  CALIBRATION: on a shared
-    1-core box the writer's pickling steals real CPU from the training
-    thread, so the honest CPU band is 2x, far above platform noise and
-    far below the regression class this guard exists for (a synchronous
-    per-epoch writeback+pickle costs many multiples — the r4 product
-    bench measured ~10x).  On the tunneled TPU host the same pull is
-    ~60 s of shared-link occupancy; BASELINE.md carries that measured
-    analysis — physics, not machinery.
+    """VERDICT r4 item 4 gate: every-epoch snapshots (interval=1) must
+    bill their cost to the background writer, not the training thread.
 
-    DE-FLAKE (ISSUE 4 satellite; VERDICT r5: passed standalone, flaked
-    in-suite under load): the baseline is measured IN-RUN and
-    INTERLEAVED — gated/active runs alternate, so a container load
-    spike (this box's cgroup CPU share swings minute to minute) hits
-    both variants instead of only the block that happened to run
-    during it, and the best-of maxima converge fairly.  Rounds are
-    bounded: the assertion is checked after each gated+active pair and
-    the test passes as soon as the band holds, up to MAX_ROUNDS pairs
-    — a real regression (the active best suppressed by multiples)
-    still fails every round."""
-    from znicz_tpu.core.mutable import Bool
+    RESTRUCTURED (VERDICT r5 next-item 6; the old form compared two
+    wall-clock throughputs, gated vs active, and flaked in-suite
+    because this box's cgroup CPU share swings 4x minute-to-minute —
+    any band wide enough to absorb that swing was too wide to mean
+    anything).  The property is WHERE the save cost lands, so test it
+    structurally: inject a deliberate DELAY into the disk-write path
+    and assert each ``save_async`` call made by the training loop
+    returns in a small fraction of it.  A regression of the guarded
+    class — the per-epoch writeback+pickle made synchronous again —
+    bills >= DELAY to every call and fails by multiples, while host
+    load cannot fake a 0.6 s stall inside a lock-append-notify.  The
+    writes still really happen (async_saves_written through the slowed
+    writer), so the worker handoff is exercised end to end, and the
+    run's decision loop overlaps compute with the artificially slow
+    writer exactly as on the TPU host, where the device->host pull is
+    ~60 s of shared-link occupancy (BASELINE.md carries that measured
+    analysis)."""
+    import tempfile
+
     from znicz_tpu.parallel.fused import FusedTrainer
     from znicz_tpu.samples import mnist
 
-    def run_once(active):
-        prng.reset(1013)
-        root.mnist.loader.n_train = 2048
-        root.mnist.loader.n_valid = 256
-        root.mnist.loader.n_test = 0
-        root.mnist.loader.minibatch_size = 256
-        root.mnist.decision.max_epochs = 4
-        root.mnist.layers = [300, 10]        # chunkier params to pull
-        root.mnist.snapshotter.interval = 1
-        try:
-            wf = mnist.MnistWorkflow()
-        finally:
-            root.mnist.layers = [100, 10]
-            root.mnist.snapshotter.interval = 0
-        wf.initialize(device=None)
-        import tempfile
+    prng.reset(1013)
+    root.mnist.loader.n_train = 512
+    root.mnist.loader.n_valid = 128
+    root.mnist.loader.n_test = 0
+    root.mnist.loader.minibatch_size = 128
+    root.mnist.decision.max_epochs = 4
+    root.mnist.snapshotter.interval = 1
+    try:
+        wf = mnist.MnistWorkflow()
+    finally:
+        root.mnist.snapshotter.interval = 0
+    wf.initialize(device=None)
+    snap = wf.snapshotter
+    snap.directory = tempfile.mkdtemp(prefix="snapstall_")
 
-        wf.snapshotter.directory = tempfile.mkdtemp(prefix="snapstall_")
-        if not active:
-            wf.snapshotter.gate_skip = Bool(True)
-        trainer = FusedTrainer(wf)
-        trainer.run()
-        if active:
-            assert wf.snapshotter.async_saves_written > 0
-        return trainer.stats["warm_img_per_sec"]
+    DELAY = 0.6
+    real_write = snap._write_host_format
 
-    run_once(True)                    # compile warm (both variants'
-    run_once(False)                   # dispatch kinds)
-    # interleaved best-of pairs: load spikes only slow runs down (see
-    # the confusion guard's rationale), and alternating the variants
-    # keeps a spike from suppressing ONE side's whole block — the exact
-    # in-suite flake mode of the old gated*3-then-active*3 ordering.
-    # A writer that really stalls the loop suppresses every active run,
-    # including the best of MAX_ROUNDS.
-    MAX_ROUNDS = 4
-    gated = active = 0.0
-    for _ in range(MAX_ROUNDS):
-        gated = max(gated, run_once(False))
-        active = max(active, run_once(True))
-        if active >= 0.5 * gated:
-            break
-    assert active >= 0.5 * gated, (active, gated)
+    def slow_write(path, s):
+        time.sleep(DELAY)               # stands in for the TPU host's
+        real_write(path, s)             # link-bound pull+write
+
+    snap._write_host_format = slow_write
+
+    calls = []
+    real_save_async = snap.save_async
+
+    def timed_save_async(s, tags):
+        t0 = time.perf_counter()
+        real_save_async(s, tags)
+        calls.append(time.perf_counter() - t0)
+
+    snap.save_async = timed_save_async
+
+    trainer = FusedTrainer(wf)
+    trainer.run()
+    # the async path was really taken, and every queued save was
+    # durably written THROUGH the slowed writer (run() drains the queue)
+    assert calls, "async snapshot path not taken"
+    assert snap.async_saves_written >= 3, snap.async_saves_written
+    # the structural gate: handing a snapshot to the writer is a
+    # lock-append-notify, orders of magnitude under DELAY; synchronous
+    # saving would bill >= DELAY per call
+    assert max(calls) < 0.4 * DELAY, (calls, DELAY)
 
 
 def test_bf16_master_weights_variant_trains():

@@ -650,7 +650,14 @@ def _good_window(clis, x1, duration, pace_hz):
             float(np.percentile(np.asarray(lats) * 1e3, 99)))
 
 
-def _run_fairness(srv, rate, n_good, window_s, rounds, factor=10.0):
+def _band_pair(fl_t, base_t, fl_p, base_p):
+    """True iff ONE flood/no-flood pair clears BOTH fairness bands."""
+    return any(ft / bt >= 0.8 and fp / bp <= 1.2
+               for ft, bt, fp, bp in zip(fl_t, base_t, fl_p, base_p))
+
+
+def _run_fairness(srv, rate, n_good, window_s, rounds, factor=10.0,
+                  flood_rows=1):
     """Interleaved no-flood/flood windows (PR-4 best-of discipline: a
     host load spike only ever slows a window, and it hits both
     variants); asserts the 20% fairness band and the flooder's
@@ -671,7 +678,8 @@ def _run_fairness(srv, rate, n_good, window_s, rounds, factor=10.0):
             for _ in range(n_good)]
     base_t, base_p, fl_t, fl_p = [], [], [], []
     stats = {}
-    flood = FloodProcess(srv.endpoint, 784, rate, factor=factor)
+    flood = FloodProcess(srv.endpoint, 784, rate, factor=factor,
+                         rows=flood_rows)
     switch = sys.getswitchinterval()
     sys.setswitchinterval(1e-3)           # bench discipline: don't let
     # 5ms GIL slices dominate the p99 of a multi-thread window
@@ -692,18 +700,24 @@ def _run_fairness(srv, rate, n_good, window_s, rounds, factor=10.0):
             assert stats["refusals"].get("rate_limited", 0) > 0, stats
             assert set(stats["refusals"]) == {"rate_limited"}, stats
             assert stats["accepted"] > 0  # its fair share still served
-            if max(fl_t) >= 0.8 * max(base_t) \
-                    and min(fl_p) <= 1.2 * min(base_p):
+            if _band_pair(fl_t, base_t, fl_p, base_p):
                 break                     # band met; stop burning time
     finally:
         sys.setswitchinterval(switch)
         flood.close()
         for c in clis:
             c.close()
-    # best-of both variants: well-behaved clients keep >= 80% of their
-    # no-flood throughput and p99 within 20%
-    assert max(fl_t) >= 0.8 * max(base_t), (base_t, fl_t)
-    assert min(fl_p) <= 1.2 * min(base_p), (base_p, fl_p)
+    # best-of PAIRS (PR-4 discipline): each flood window is judged
+    # against its ADJACENT no-flood window, so a cgroup/load phase hits
+    # both sides of a ratio; comparing global min-vs-min across rounds
+    # measured minutes apart just measures the host's swing.  A
+    # structurally unfair service fails EVERY pair; one clean-phase
+    # pair inside BOTH bands clears it: well-behaved clients keep
+    # >= 80% of their paired no-flood throughput AND p99 within 20%,
+    # in the SAME pair (a service unfair in alternating ways must not
+    # pass by mixing one pair's throughput with another pair's p99).
+    assert _band_pair(fl_t, base_t, fl_p, base_p), \
+        (base_t, fl_t, base_p, fl_p)
     return stats
 
 
@@ -713,18 +727,24 @@ def test_fairness_under_flood_and_refusal_policies_lean():
 
     wf = _tiny_mnist_wf()
     rate = 20.0                           # rows/s per client — the
-    # flood offers 200/s, a packet rate this 1-core container's router
-    # absorbs while refusing (CPU itself is not a resource admission
-    # control can ration; the flood's WORK must fit the host)
+    # flood offers 200 rows/s as 8-row requests (25 msg/s): the bucket
+    # meters ROWS, so this is the same 10x overload, but the lean test
+    # must fit this 1-core container — at 200 one-row msg/s the flood
+    # process's scheduler quanta alone push good-client p99 2-10x out
+    # of band (CPU itself is not a resource admission control can
+    # ration; the flood's WORK must fit the host).  The slow soak keeps
+    # the per-message variant.  burst=8 so an 8-row request is ever
+    # admittable (accepted>0 asserts the fair share is still served).
     srv = InferenceServer(
         wf, max_batch=8, max_delay_ms=2.0, queue_bound=64,
         admission=AdmissionPolicy(rate_limit=rate,
-                                  rate_burst=rate / 4)).start()
+                                  rate_burst=8.0)).start()
     try:
         # 6 best-of rounds with early exit (usually 1-2 run): this
         # box's cgroup share swings 4x minute-to-minute, and a 3-round
         # run can land entirely inside one bad phase
-        _run_fairness(srv, rate, n_good=2, window_s=2.0, rounds=6)
+        _run_fairness(srv, rate, n_good=2, window_s=2.0, rounds=6,
+                      flood_rows=8)
 
         # refusal-policy propagation: every refusal reply NAMES the
         # policy that refused it

@@ -293,8 +293,12 @@ class FloodDriver:
     per-client rate limit — the admission-control fairness proof's
     misbehaving tenant.
 
-    Open-loop single-request arrivals at ``rate_rows_per_s * factor``
-    on a daemon thread; every reply is accounted, none raises:
+    Open-loop arrivals totalling ``rate_rows_per_s * factor`` rows/s
+    on a daemon thread — ``x`` may carry several rows per request (the
+    admission bucket meters ROWS, so a row-batched flood is the same
+    10× overload with proportionally fewer messages; the per-message
+    variant doubles as a packet flood).  Every reply is accounted,
+    none raises:
     ``accepted`` counts ok replies, ``refusals`` buckets refusal
     replies by the ``policy`` that refused them (a fairness test
     asserts this is ALL ``rate_limited``).  The breaker is disabled on
@@ -306,6 +310,7 @@ class FloodDriver:
                  max_in_flight: int = 256):
         self.endpoint = endpoint
         self.x = x
+        self.rows = int(x.shape[0]) if getattr(x, "ndim", 1) > 1 else 1
         self.rate = float(rate_rows_per_s) * float(factor)
         self.client_id = client_id
         self.max_in_flight = int(max_in_flight)
@@ -343,7 +348,8 @@ class FloodDriver:
                 # burst catch-up: send EVERY due request, not one per
                 # loop tick — the offered rate must actually reach
                 # factor x rate_limit, not the loop's poll cadence
-                while (time.perf_counter() - t0 >= self.sent / self.rate
+                while (time.perf_counter() - t0
+                       >= (self.sent * self.rows) / self.rate
                        and cli.in_flight < self.max_in_flight
                        and not self._stop.is_set()):
                     cli.submit(self.x)
@@ -375,14 +381,16 @@ class FloodProcess:
 
     def __init__(self, endpoint: str, sample_dim: int,
                  rate_rows_per_s: float, factor: float = 10.0,
-                 client_id: str = "flooder", max_in_flight: int = 32):
+                 client_id: str = "flooder", max_in_flight: int = 32,
+                 rows: int = 1):
         import subprocess
         import sys
 
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "znicz_tpu.parallel.chaos", "--flood",
              endpoint, str(int(sample_dim)), str(float(rate_rows_per_s)),
-             str(float(factor)), client_id, str(int(max_in_flight))],
+             str(float(factor)), client_id, str(int(max_in_flight)),
+             str(int(rows))],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
             bufsize=1)
         line = self._proc.stdout.readline().strip()
@@ -417,8 +425,8 @@ def _flood_main(argv: List[str]) -> None:  # pragma: no cover - subprocess
     import json
     import sys
 
-    endpoint, dim, rate, factor, client_id, mif = argv
-    x = np.zeros((1, int(dim)), np.float32)
+    endpoint, dim, rate, factor, client_id, mif, rows = argv
+    x = np.zeros((int(rows), int(dim)), np.float32)
     print("ready", flush=True)
     driver: Optional[FloodDriver] = None
     for line in sys.stdin:
