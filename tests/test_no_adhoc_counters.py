@@ -144,6 +144,70 @@ def test_every_serving_config_read_is_declared_in_defaults():
         "fix the typo):\n  " + "\n  ".join(offenders))
 
 
+# -- engine config-knob lint (ISSUE 7 satellite) -------------------------------
+#
+# Same regression class as the serving lint above, for the tree where
+# this PR's knobs land (``compute_dtype``, ``fused_tail``,
+# ``async_staging``, ``staging_donate``, ``xla_latency_hiding``): every
+# literal ``root.common.engine.*`` read in the package must be declared
+# in core/config.py ENGINE_DEFAULTS, and the subtree must never be bound
+# to a variable (which would hide later ``.get()`` reads from the lint).
+
+ENGINE_CFG = re.compile(
+    r"root\.common\.engine\b(?P<chain>(?:\.get\(\s*\"\w+\"|\.\w+)*)")
+
+ENGINE_ALIAS = re.compile(
+    r"(?<![=!<>])=\s*root\.common\.engine\s*(?:#.*)?$", re.M)
+
+
+def _engine_defaults():
+    from znicz_tpu.core.config import ENGINE_DEFAULTS
+
+    return set(ENGINE_DEFAULTS)
+
+
+def test_every_engine_config_read_is_declared_in_defaults():
+    declared = _engine_defaults()
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        text = path.read_text()
+        for m in ENGINE_CFG.finditer(text):
+            key = _chain_key(m.group("chain"))
+            if key and key not in declared:
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(
+                    f"{rel}:{line}: root.common.engine.{key}")
+        for m in ENGINE_ALIAS.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            offenders.append(
+                f"{rel}:{line}: engine config subtree bound to a "
+                f"variable — later .get() reads are invisible to this "
+                f"lint; spell the literal chain at each read site")
+    assert not offenders, (
+        "engine config keys read in code but missing from "
+        "ENGINE_DEFAULTS (znicz_tpu/core/config.py) — an undeclared "
+        "knob is silently ignored by dotted overrides; declare it (or "
+        "fix the typo):\n  " + "\n  ".join(offenders))
+
+
+def test_engine_config_lint_catches_the_regression_class():
+    m = ENGINE_CFG.search('root.common.engine.get("bogus_knob", 1)')
+    assert _chain_key(m.group("chain")) == "bogus_knob"
+    assert "bogus_knob" not in _engine_defaults()
+    m = ENGINE_CFG.search('root.common.engine.compute_dtype = "bf16"')
+    assert _chain_key(m.group("chain")) == "compute_dtype"
+    for key in ("compute_dtype", "fused_tail", "async_staging",
+                "staging_donate", "xla_latency_hiding", "scan_chunk"):
+        assert key in _engine_defaults(), key
+    # aliasing the subtree is itself an offense; literal reads are not
+    assert ENGINE_ALIAS.search("eng = root.common.engine")
+    assert not ENGINE_ALIAS.search(
+        'chunk = root.common.engine.get("scan_chunk", 8)')
+    assert not ENGINE_ALIAS.search(
+        "if x == root.common.engine:")
+
+
 def test_serving_config_lint_catches_the_regression_class():
     """The lint must fire on undeclared keys and stay quiet on
     declared ones and on the dynamic _cfg read."""

@@ -9,9 +9,66 @@ the reference's master/slave distribution, SURVEY.md §2.4).
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+#: XLA latency-hiding-scheduler flags (ISSUE 7, lever c): reorder the TPU
+#: schedule so async copies (the staged-segment H2D puts, collective
+#: permutes) overlap compute instead of serializing at their use sites —
+#: the compiler-side half of the ingest/compute overlap the DeviceStager
+#: provides on the host side.  Published flag set (the standard pairing
+#: quoted in the JAX/maxtext perf guides); TPU-only semantics, harmless
+#: but useless text on CPU — the knob below gates them off by default.
+LATENCY_HIDING_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_host_transfer_overlap_limit=8",
+    "--xla_latency_hiding_scheduler_rerun=2",
+)
+
+
+def configure_xla_flags(environ=None) -> Tuple[str, ...]:
+    """Append the latency-hiding-scheduler flags to ``XLA_FLAGS`` when
+    ``root.common.engine.xla_latency_hiding`` is on (default OFF — a
+    labeled bench variant until the BASELINE.md r12 protocol records the
+    with/without numbers).  MUST run before the first jax backend
+    initialization — the launcher calls it right after config/overrides
+    are applied; if a backend already exists the env change is inert, so
+    this warns instead of silently lying.  Idempotent (flags already
+    present are not duplicated).  Returns the flags newly appended."""
+    from znicz_tpu.core.config import root
+
+    if environ is None:
+        environ = os.environ
+    if not bool(root.common.engine.get("xla_latency_hiding", False)):
+        return ()
+    current = environ.get("XLA_FLAGS", "")
+    # dedup by flag NAME, not full string: a flag the operator already
+    # set (any value) is respected, never shadowed by an appended
+    # duplicate (XLA parses last-wins)
+    fresh = tuple(f for f in LATENCY_HIDING_XLA_FLAGS
+                  if f.split("=", 1)[0] not in current)
+    if not fresh:
+        return ()
+    jax = sys.modules.get("jax")
+    # the inert-after-init refusal applies to the REAL process env only
+    # (a scratch dict is a harness inspecting what WOULD be applied)
+    if jax is not None and environ is os.environ:
+        try:
+            initialized = bool(
+                jax._src.xla_bridge._backends)  # noqa: SLF001
+        except Exception:               # pragma: no cover - jax internals
+            initialized = False
+        if initialized:
+            print("warning: xla_latency_hiding set after the jax backend "
+                  "initialized — XLA_FLAGS changes are inert now; set the "
+                  "knob via config/CLI overrides (the launcher applies "
+                  "them before building the workflow)", file=sys.stderr)
+            return ()
+    environ["XLA_FLAGS"] = (current + " " + " ".join(fresh)).strip()
+    return fresh
 
 
 class Device:

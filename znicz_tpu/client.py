@@ -335,19 +335,23 @@ class Client:
         from znicz_tpu.network_common import handshake_request
         from znicz_tpu.parallel import wire
 
-        eng = root.common.engine
         if max_reconnects is None:
-            max_reconnects = int(eng.get("slave_reconnects", 8))
+            max_reconnects = int(
+                root.common.engine.get("slave_reconnects", 8))
         if backoff_base is None:
-            backoff_base = float(eng.get("slave_backoff_base", 0.25))
+            backoff_base = float(
+                root.common.engine.get("slave_backoff_base", 0.25))
         if backoff_cap is None:
-            backoff_cap = float(eng.get("slave_backoff_cap", 5.0))
+            backoff_cap = float(
+                root.common.engine.get("slave_backoff_cap", 5.0))
         # wire-v3 knobs: delta quantization (error-feedback residuals
-        # live in the encoder, one per tensor) and the job prefetcher
+        # live in the encoder, one per tensor) and the job prefetcher.
+        # Literal config chains at each read site — the engine-knob lint
+        # (tests/test_no_adhoc_counters.py) refuses subtree aliasing.
         self.wire_dtype = wire.canonical_wire_dtype(
-            eng.get("wire_dtype", "float32"))
+            root.common.engine.get("wire_dtype", "float32"))
         self._delta_encoder = wire.DeltaEncoder(self.wire_dtype)
-        prefetch_on = bool(eng.get("job_prefetch", True))
+        prefetch_on = bool(root.common.engine.get("job_prefetch", True))
         log = logging.getLogger("znicz")
 
         if any(isinstance(u, LearningRateAdjust)

@@ -167,3 +167,64 @@ root.common.engine.precision = "float32"  # "float32" | "bfloat16" activations
 root.common.dirs.snapshots = "snapshots"
 root.common.dirs.cache = ".znicz_cache"
 root.common.dirs.datasets = "datasets"
+
+#: Declaration table for every ``root.common.engine.*`` knob the package
+#: reads (ISSUE 7 satellite — the serving DEFAULTS discipline extended to
+#: the engine tree).  The Config tree autovivifies, so an undeclared or
+#: typo'd knob silently reads as its default forever under dotted CLI
+#: overrides; tests/test_no_adhoc_counters.py greps every literal
+#: ``root.common.engine`` access in the package and fails on keys missing
+#: here.  Values are the DOCUMENTED defaults (the read sites keep their
+#: own — this table declares, it does not apply).
+ENGINE_DEFAULTS = {
+    # core
+    "seed": 1013,
+    "backend": "auto",            # "tpu" | "cpu" | "auto"
+    "fuse": True,                 # compile fused train steps
+    "fused": False,               # launcher --fused (fast-path engine)
+    # precision (ISSUE 7: compute_dtype is canonical; precision legacy)
+    "precision": "float32",       # legacy alias of compute_dtype
+    "compute_dtype": None,        # "float32" | "bf16"/"bfloat16"
+    "master_dtype": "float32",    # bf16-STORED master weights (variant)
+    "state_dtype": "float32",     # optimizer-state (velocity) storage
+    # fused-trainer shape
+    "remat": False,
+    "scan_chunk": 8,
+    "pipeline_depth": 1,
+    "async_snapshot": True,
+    # fusion experiments / kernels
+    "fused_elementwise": False,   # conv1/conv2 single-pass Pallas block
+    "fused_tail": False,          # ISSUE 7: conv3-5 + FC + loss epilogues
+    "lrn_pow": False,
+    "lrn_autodiff": False,
+    "pallas_lrn": False,
+    "pool_bwd": "sas",            # "sas" | "mask"
+    # ingest / staging (ISSUE 7)
+    "prefetch_segments": 2,
+    "decode_workers": None,
+    "stream_budget_mb": None,
+    "native_shuffle": False,
+    "async_staging": True,        # double-buffered device staging
+    "staging_donate": True,       # donate staged buffers (non-CPU)
+    "xla_latency_hiding": False,  # XLA latency-hiding-scheduler flags
+    # snapshots
+    "snapshot_format": "pickle",
+    "snapshot_sharded": False,
+    "snapshot_min_interval_s": 0.0,
+    # master/slave roles + wire
+    "mode": "",                   # "" | "master" | "slave"
+    "master_bind": "tcp://*:5570",
+    "master_resume": "",
+    "slave_endpoint": None,
+    "job_segment": 1,
+    "job_prefetch": True,
+    "job_timeout_mult": 8.0,
+    "slave_ttl": 60.0,
+    "slave_reconnects": 8,
+    "slave_backoff_base": 0.25,
+    "slave_backoff_cap": 5.0,
+    "quarantine_norm_mult": 25.0,
+    "master_snapshot_s": 10.0,
+    "wire_dtype": "float32",      # "float32" | "bfloat16" | "int8"
+    "wire_compress": "none",      # "none" | "zlib" | "lz4"
+}

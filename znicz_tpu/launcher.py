@@ -168,6 +168,13 @@ class Launcher:
             _load_module(args.config, "znicz_tpu._user_config")
         if args.overrides:
             apply_overrides(root, args.overrides)
+        # XLA scheduler flags must land in the env BEFORE the workflow
+        # module's first jax backend init (ISSUE 7: the latency-hiding
+        # scheduler is the compiler half of ingest/compute overlap;
+        # root.common.engine.xla_latency_hiding, default off)
+        from znicz_tpu.backends import configure_xla_flags
+
+        configure_xla_flags()
         spec = args.workflow
         if spec in SAMPLES:
             spec = f"znicz_tpu.samples.{spec}"

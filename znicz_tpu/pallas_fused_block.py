@@ -340,16 +340,303 @@ def plan_fused_blocks(forwards: Sequence) -> Dict[int, FusedBlockSpec]:
     pure — BASELINE.md anchor-defense protocol)."""
     from znicz_tpu.core.config import root
 
-    eng = root.common.engine
-    if not bool(eng.get("fused_elementwise", False)):
+    if not bool(root.common.engine.get("fused_elementwise", False)):
         return {}
-    if any(bool(eng.get(knob, False))
+    if any(bool(root.common.engine.get(knob, False))
            for knob in ("lrn_pow", "lrn_autodiff", "pallas_lrn")):
         return {}
     plan: Dict[int, FusedBlockSpec] = {}
     i = 0
     while i < len(forwards):
         spec = match_fused_block(forwards, i)
+        if spec is not None:
+            plan[i] = spec
+            i += spec.span
+        else:
+            i += 1
+    return plan
+
+
+# -- the AlexNet tail (ISSUE 7) ------------------------------------------------
+#
+# The conv1/conv2 block kernel above left the TAIL of the network on the
+# composed path: conv3-5's bias+StrictRELU, the fc6/fc7
+# bias+StrictRELU+dropout epilogues, and the softmax-CE loss head.  Each
+# of those is elementwise work whose AUTODIFF residuals (ReLU gates,
+# dropout masks, softmax probabilities) round-trip HBM between the
+# forward and backward passes — for AlexNet at batch 128 that is
+# ~27 MB/step of pure mask traffic on top of the activations.  The three
+# tail stages below each carry a ``jax.custom_vjp`` whose residual is
+# ONLY what already exists (the stage's raw linear input + params): the
+# backward recomputes every mask in-register instead of loading it.
+#
+# Engagement: ``root.common.engine.fused_tail`` (default OFF — same
+# BASELINE.md hand-off discipline as ``fused_elementwise``; bench.py
+# ``--fused-tail`` is the labeled with/without protocol).  Where BOTH
+# knobs are on, the conv1/conv2 BLOCK matcher wins its span and the tail
+# matcher takes everything else.
+
+
+class FusedTailSpec(NamedTuple):
+    """One matched tail-stage occurrence in a forwards list."""
+
+    kind: str                  # "conv_bias_relu" | "fc_epilogue"
+    span: int                  # units consumed
+    ratio: float = 0.0         # dropout ratio (fc_epilogue only)
+    dropout_index: int = -1    # forwards index of the absorbed dropout
+    #                            unit (-1 = no dropout); the fused mask
+    #                            key is fold_in(key, dropout_index) —
+    #                            bit-identical to the unit path's draw
+
+
+def _bias_relu_fwd_kernel(x_ref, b_ref, out_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    out_ref[0] = jnp.maximum(x + b, 0.0).astype(out_ref.dtype)
+
+
+def _bias_relu_bwd_kernel(x_ref, b_ref, dp_ref, dx_ref, db_ref):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    x = x_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    dp = dp_ref[0].astype(jnp.float32)
+    da = dp * ((x + b) > 0.0).astype(jnp.float32)
+    dx_ref[0] = da.astype(dx_ref.dtype)
+    partial = jnp.sum(da, axis=(0, 1))
+    bi = pl.program_id(0)
+
+    @pl.when(bi == 0)
+    def _():
+        db_ref[0] = partial
+
+    @pl.when(bi > 0)
+    def _():
+        db_ref[0] = db_ref[0] + partial
+
+
+def _call_bias_relu_fwd(x, bias):
+    import jax
+    from jax.experimental import pallas as pl
+
+    B, H, W, C = x.shape
+    return pl.pallas_call(
+        _bias_relu_fwd_kernel,
+        grid=(B,),
+        in_specs=[_img_spec(x.shape), _bias_spec(C)],
+        out_specs=_img_spec(x.shape),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_use_interpret(),
+    )(x, bias.reshape(1, C))
+
+
+def _call_bias_relu_bwd(x, bias, dp):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, W, C = x.shape
+    dx, db = pl.pallas_call(
+        _bias_relu_bwd_kernel,
+        grid=(B,),
+        in_specs=[_img_spec(x.shape), _bias_spec(C), _img_spec(x.shape)],
+        out_specs=(_img_spec(x.shape), _bias_spec(C)),
+        out_shape=(jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32)),
+        compiler_params=_compiler_params(),
+        interpret=_use_interpret(),
+    )(x, bias.reshape(1, C), dp)
+    return dx, db.reshape(bias.shape).astype(bias.dtype)
+
+
+def _make_bias_relu():
+    import jax
+
+    @jax.custom_vjp
+    def bias_relu(x, bias):
+        return _call_bias_relu_fwd(x, bias)
+
+    def fwd(x, bias):
+        # residual is (x, bias) only — the ReLU gate is recomputed by
+        # the backward kernel in VMEM, never written to HBM
+        return bias_relu(x, bias), (x, bias)
+
+    def bwd(res, dp):
+        x, bias = res
+        return _call_bias_relu_bwd(x, bias, dp)
+
+    bias_relu.defvjp(fwd, bwd)
+    return bias_relu
+
+
+_bias_relu = None
+
+
+def fused_bias_relu(x, bias):
+    """Fused bias+StrictRELU over a (B, H, W, C) conv output — the
+    conv3-5 tail stage (no LRN, no pool there) as ONE Pallas pass each
+    way: forward reads x once and writes relu(x+b) once; backward reads
+    (x, bias, d_out) once and writes (dx, dbias) once, the ReLU gate
+    living only in VMEM.  Internal arithmetic is f32 even for bf16
+    operands (outputs cast back), matching the block kernel's policy."""
+    global _bias_relu
+    if _bias_relu is None:
+        _bias_relu = _make_bias_relu()
+    assert x.ndim == 4, f"fused_bias_relu expects NHWC, got {x.shape}"
+    return _bias_relu(x, bias)
+
+
+def fused_fc_epilogue(y, bias, key, ratio, train):
+    """Fused FC-layer epilogue — bias+StrictRELU(+inverted-scale dropout)
+    over the raw GEMM output ``y`` as ONE custom-vjp stage.  The forward
+    is a single elementwise fusion; the backward recomputes the ReLU gate
+    from (y, bias) and the dropout mask FROM THE KEY instead of loading
+    either from HBM (the 4096-wide fc6/fc7 masks are the dominant
+    non-GEMM autodiff residual).  The mask is ``DropoutForward.
+    make_mask``'s own bernoulli draw with the caller's key, so fused and
+    unfused paths apply BIT-IDENTICAL masks — e2e trainer parity is
+    exact, not distributional.  ``key`` may be None when no mask applies
+    (eval, or ratio 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.dropout import DropoutForward
+
+    use_mask = bool(train) and float(ratio) > 0.0 and key is not None
+    shape, ratio = y.shape, float(ratio)
+
+    def mask_of():
+        return DropoutForward.make_mask(key, shape, ratio)
+
+    @jax.custom_vjp
+    def epilogue(y, b):
+        r = jnp.maximum(y + b, 0.0)
+        return r * mask_of() if use_mask else r
+
+    def fwd(y, b):
+        return epilogue(y, b), (y, b)
+
+    def bwd(res, g):
+        y, b = res
+        da = g * ((y + b) > 0.0).astype(g.dtype)
+        if use_mask:
+            da = da * mask_of().astype(g.dtype)
+        return da.astype(y.dtype), jnp.sum(da, axis=0).astype(b.dtype)
+
+    epilogue.defvjp(fwd, bwd)
+    return epilogue(y, bias)
+
+
+def fused_softmax_xent(logits, labels, valid, denom):
+    """Softmax-CE loss + gradient as ONE custom-vjp epilogue.  Forward is
+    the max-subtracted logsumexp CE — the IDENTICAL formula the composed
+    trainer loss uses (``logsumexp(logits) - logits[label]``, masked and
+    batch-mean scaled).  Backward writes ``(softmax(logits) - onehot) *
+    valid / denom`` in a single fusion that re-reads the logits (which
+    must exist anyway — they are the FC head's output) instead of
+    consuming saved logsumexp/softmax residuals; for the 1000-class
+    AlexNet head that is the difference between one HBM read and three.
+    ``labels``/``valid``/``denom`` are closed over (non-differentiable
+    operands)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def loss_of(lg):
+        logz = jax.nn.logsumexp(lg, axis=-1)
+        ll = jnp.take_along_axis(lg, labels[:, None], axis=-1)[:, 0]
+        return jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
+
+    def fwd(lg):
+        return loss_of(lg), (lg,)
+
+    def bwd(res, g):
+        lg, = res
+        p = jax.nn.softmax(lg, axis=-1)
+        onehot = jax.nn.one_hot(labels, lg.shape[-1], dtype=lg.dtype)
+        d = (p - onehot) * valid[:, None].astype(lg.dtype) / denom * g
+        return (d,)
+
+    loss_of.defvjp(fwd, bwd)
+    return loss_of(logits)
+
+
+def match_conv_bias_relu(forwards: Sequence, i: int) \
+        -> Optional[FusedTailSpec]:
+    """Conv(+bias) with a StrictRELU — fused into the class (span 1) or a
+    standalone activation unit (span 2) — with NO LRN/pool requirement:
+    the conv3-5 shape.  (Where the full conv-block matcher also fires,
+    ``plan_fused_tail`` lets the block win its span.)"""
+    from znicz_tpu.activation import is_strict_relu_unit
+    from znicz_tpu.conv import Conv
+    from znicz_tpu.ops import activations
+
+    conv = forwards[i]
+    if not isinstance(conv, Conv) or not conv.include_bias:
+        return None
+    if conv.ACTIVATION is activations.strict_relu:
+        return FusedTailSpec("conv_bias_relu", 1)
+    if conv.ACTIVATION is activations.identity and i + 1 < len(forwards) \
+            and is_strict_relu_unit(forwards[i + 1]):
+        return FusedTailSpec("conv_bias_relu", 2)
+    return None
+
+
+def match_fc_epilogue(forwards: Sequence, i: int) -> Optional[FusedTailSpec]:
+    """All2AllStrictRELU(+bias), optionally followed by a DropoutForward
+    it absorbs (span 2) — the fc6/fc7 shape.  The softmax head is NOT
+    matched here (its epilogue is the loss head, ``fused_softmax_xent``,
+    routed by the trainer's loss function)."""
+    from znicz_tpu.all2all import All2All, All2AllSoftmax
+    from znicz_tpu.dropout import DropoutForward
+    from znicz_tpu.ops import activations
+
+    f = forwards[i]
+    if not isinstance(f, All2All) or isinstance(f, All2AllSoftmax):
+        return None
+    if type(f).ACTIVATION is not activations.strict_relu \
+            or not f.include_bias:
+        return None
+    if i + 1 < len(forwards) and isinstance(forwards[i + 1],
+                                            DropoutForward):
+        return FusedTailSpec("fc_epilogue", 2,
+                             float(forwards[i + 1].dropout_ratio), i + 1)
+    return FusedTailSpec("fc_epilogue", 1)
+
+
+def fused_tail_enabled() -> bool:
+    """The ``root.common.engine.fused_tail`` gate (default OFF — engages
+    per the BASELINE.md r12 protocol; bench.py ``--fused-tail``)."""
+    from znicz_tpu.core.config import root
+
+    return bool(root.common.engine.get("fused_tail", False))
+
+
+def plan_fused_tail(forwards: Sequence,
+                    block_plan: Optional[Dict[int, FusedBlockSpec]] = None
+                    ) -> Dict[int, FusedTailSpec]:
+    """start-index -> FusedTailSpec for every fusable tail stage, or {}
+    when ``fused_tail`` is off.  Indices covered by a conv-block span
+    (``block_plan``) are skipped — the single-pass block kernel already
+    owns their bias+ReLU."""
+    if not fused_tail_enabled():
+        return {}
+    covered = set()
+    for i, spec in (block_plan or {}).items():
+        covered.update(range(i, i + spec.span))
+    plan: Dict[int, FusedTailSpec] = {}
+    i = 0
+    while i < len(forwards):
+        if i in covered:
+            i += 1
+            continue
+        spec = match_conv_bias_relu(forwards, i)
+        if spec is None:
+            spec = match_fc_epilogue(forwards, i)
         if spec is not None:
             plan[i] = spec
             i += spec.span

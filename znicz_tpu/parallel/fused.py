@@ -129,6 +129,9 @@ class FusedTrainer:
         self._train_scan = None
         self._eval_step = None
         self._eval_scan = None
+        #: the live DeviceStager while a staged run is inside
+        #: _run_segmented with async staging on (tests/bench observe it)
+        self._stager = None
         self._key0 = prng.get("fused_trainer").jax_key(0)
         self.steps_done = 0
         #: per-step timing accumulated by run() (SURVEY.md §5 Tracing —
@@ -159,10 +162,35 @@ class FusedTrainer:
         self._m_step_seconds = _sc.histogram(
             "step_seconds", "per-step wall time (pipelined intervals)",
             size=4096)
-        self.compute_dtype = (np.dtype("float32")
-                              if root.common.engine.get("precision",
-                                                        "float32")
-                              == "float32" else "bfloat16")
+        #: compute dtype (activations + gradients; master weights stay
+        #: f32): ``root.common.engine.compute_dtype`` is the canonical
+        #: knob ("float32" | "bf16" | "bfloat16"); the pre-r12
+        #: ``precision`` spelling is kept as the legacy alias and applies
+        #: only when compute_dtype is unset.
+        cd = root.common.engine.get("compute_dtype", None)
+        if cd is None:
+            cd = root.common.engine.get("precision", "float32")
+        cd = {"bf16": "bfloat16"}.get(str(cd), str(cd))
+        if cd not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"root.common.engine.compute_dtype={cd!r}: must be "
+                "'float32' or 'bf16'/'bfloat16'")
+        self.compute_dtype = (np.dtype("float32") if cd == "float32"
+                              else "bfloat16")
+        #: the per-step compute_dtype label on /metrics (ISSUE 7
+        #: satellite): a labeled gauge, so the TPU session's dashboards
+        #: can tell WHICH precision a run's step timings belong to
+        #: without a profiler
+        _sc.gauge("compute_dtype", "active compute dtype (value always 1;"
+                  " read the dtype label)", dtype=cd).set(1)
+        #: trace-time tick per compiled fused executable (the serving
+        #: layer's zero-recompile method, now on the training path):
+        #: Python runs a jitted wrapper's body only when jax (re)traces,
+        #: so ``compiles`` == executable-cache entries, cross-checkable
+        #: against ``jit_cache_sizes()``
+        self._m_compiles = _sc.counter(
+            "compiles", "traces of the fused step/scan executables == "
+            "jit cache entries")
         #: OPT-IN bf16 MASTER weights (root.common.engine.master_dtype =
         #: "bfloat16", fused path only): params are STORED bf16 — the
         #: per-step read+write of the full param set halves (AlexNet fc:
@@ -396,14 +424,27 @@ class FusedTrainer:
         tiling MaxPooling) runs as the raw conv plus ONE single-pass
         Pallas kernel whose custom vjp is the fused backward — the graph
         the GradientDescent* chain would otherwise differentiate op by op
-        (pallas_fused_block; plan computed per trace, shapes unchanged)."""
+        (pallas_fused_block; plan computed per trace, shapes unchanged).
+
+        With ``root.common.engine.fused_tail`` on (ISSUE 7), the REST of
+        the AlexNet shape fuses too: conv3-5-style bias+StrictRELU as one
+        Pallas pass each way (``fused_bias_relu``), and the FC layers'
+        bias+ReLU+dropout epilogue as one custom-vjp stage whose backward
+        recomputes the masks from (input, bias, key) instead of loading
+        them from HBM (``fused_fc_epilogue`` — the dropout key is the
+        absorbed unit's own ``fold_in(key, i)`` draw, so masks are
+        bit-identical to the unit path's)."""
         import jax
 
         from znicz_tpu.ops.linear import linear
-        from znicz_tpu.pallas_fused_block import fused_block, \
-            plan_fused_blocks
+        from znicz_tpu.pallas_fused_block import (fused_bias_relu,
+                                                  fused_block,
+                                                  fused_fc_epilogue,
+                                                  plan_fused_blocks,
+                                                  plan_fused_tail)
 
         plan = plan_fused_blocks(self.forwards)
+        tail_plan = plan_fused_tail(self.forwards, plan)
         h = x
         last = self.forwards[-1]
         i = 0
@@ -420,6 +461,22 @@ class FusedTrainer:
                 # dropout/stochpool never sit inside a fused block, so
                 # later units keep their own fold_in(key, i) indices
                 i += blk.span
+                continue
+            tl = tail_plan.get(i)
+            if tl is not None:
+                if tl.kind == "conv_bias_relu":
+                    h = f.apply_linear(p, h)
+                    h = fused_bias_relu(h, p["bias"])
+                else:                           # fc_epilogue
+                    y = linear(h, p["weights"],
+                               weights_transposed=f.weights_transposed)
+                    masked = train and tl.dropout_index >= 0
+                    k = (jax.random.fold_in(key, tl.dropout_index)
+                         if masked else None)
+                    y = fused_fc_epilogue(y, p["bias"], k, tl.ratio,
+                                          masked)
+                    h = y.reshape((x.shape[0],) + f.output_sample_shape)
+                i += tl.span
                 continue
             if isinstance(f, self._dropout_cls):
                 if train:
@@ -467,9 +524,19 @@ class FusedTrainer:
         if self.loss_kind == "softmax":
             logits = out
             labels = target
-            logz = jax.nn.logsumexp(logits, axis=-1)
-            ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-            loss = jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
+            from znicz_tpu.pallas_fused_block import (fused_softmax_xent,
+                                                      fused_tail_enabled)
+
+            if fused_tail_enabled():
+                # ISSUE 7: loss + logits-cotangent as ONE custom-vjp
+                # epilogue (same formula; backward re-reads logits
+                # instead of consuming saved softmax/logsumexp residuals)
+                loss = fused_softmax_xent(logits, labels, valid, denom)
+            else:
+                logz = jax.nn.logsumexp(logits, axis=-1)
+                ll = jnp.take_along_axis(logits, labels[:, None],
+                                         axis=-1)[:, 0]
+                loss = jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
             pred = jnp.argmax(logits, axis=-1)
             n_err = jnp.sum((pred != labels) & valid)
             if self.compute_confusion:
@@ -602,7 +669,33 @@ class FusedTrainer:
         adjustment (LearningRateAdjust) never recompiles."""
         import jax
 
-        return jax.jit(self._step_core, donate_argnums=(0, 1))
+        compiles = self._m_compiles
+
+        def step(params, velocities, hypers, dataset, targets, idx,
+                 batch_size, key):
+            compiles.inc()              # trace-time tick (one per compile)
+            return self._step_core(params, velocities, hypers, dataset,
+                                   targets, idx, batch_size, key)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def jit_cache_sizes(self) -> Dict[str, int]:
+        """jax's own executable-cache entry counts for the live jitted
+        step/scan functions (the pjit cache behind ``_cache_size``; absent
+        entries mean the jax version does not expose it).  After warmup
+        the SUM equals ``compiles`` and must stay put — the training-path
+        zero-recompile proof (same method as serving's ModelRunner)."""
+        out: Dict[str, int] = {}
+        for name in ("_train_step", "_train_scan", "_eval_step",
+                     "_eval_scan"):
+            fn = getattr(self, name, None)
+            if fn is None:
+                continue
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:           # pragma: no cover - jax-version dep
+                pass
+        return out
 
     def _n_confusion(self) -> int:
         return (self.forwards[-1].output_samples_number
@@ -683,9 +776,11 @@ class FusedTrainer:
         import jax.numpy as jnp
 
         nc = self._n_confusion()
+        compiles = self._m_compiles
 
         def chunk(params, velocities, hypers_mat, dataset, targets,
                   idx_mat, bs_vec, base_key, step_nums):
+            compiles.inc()
             (p, v, conf_sum), ms = jax.lax.scan(
                 self._train_scan_body(dataset, targets, base_key),
                 (params, velocities, jnp.zeros((nc, nc), jnp.int32)),
@@ -703,9 +798,11 @@ class FusedTrainer:
         import jax.numpy as jnp
 
         nc = self._n_confusion()
+        compiles = self._m_compiles
 
         @jax.jit
         def chunk(params, dataset, targets, idx_mat, bs_vec):
+            compiles.inc()
             conf_sum, ms = jax.lax.scan(
                 self._eval_scan_body(params, dataset, targets),
                 jnp.zeros((nc, nc), jnp.int32), (idx_mat, bs_vec))
@@ -722,8 +819,11 @@ class FusedTrainer:
         import jax
         from functools import partial
 
+        compiles = self._m_compiles
+
         @partial(jax.jit, static_argnums=(6,))
         def step(params, dataset, targets, idx, batch_size, key, train):
+            compiles.inc()
             data = self._gather_decode(dataset, idx)
             tgt = jax.numpy.take(targets, idx, axis=0)
             _, metrics = self.loss_and_metrics(
@@ -931,35 +1031,60 @@ class FusedTrainer:
                 jax.make_array_from_callback(
                     shape_t, sh_t, lambda i: cb(tgt_gather, i)))
 
+    def _staging_donation(self) -> bool:
+        """Donate the staged (K, B, ...) segment buffers into the direct
+        train scan (``root.common.engine.staging_donate``, default on):
+        with the async double-buffer at most two staged segments exist —
+        the one the device is consuming (its HBM reusable for activations
+        the instant the scan's slice reads it) and the one the stager is
+        putting — the serving layer's ping-pong discipline on the
+        training path.  Auto-off on CPU, where the runtime ignores
+        donation (and warns per compile) — same backend resolution as
+        ``ModelRunner.donate``."""
+        import jax
+
+        return (bool(root.common.engine.get("staging_donate", True))
+                and jax.default_backend() != "cpu")
+
     def make_train_scan_direct(self):
         """The staged twin of ``make_train_scan``: K steps in one
         dispatch, with the K minibatches riding in the scan xs as
         (K, B, ...) tensors instead of being gathered from a resident
         dataset (same ``_train_body``).  Sliced per step, each (B, ...)
-        batch keeps its ``data`` sharding — no gather, no resharding."""
+        batch keeps its ``data`` sharding — no gather, no resharding.
+        The staged segment buffers are DONATED where the backend supports
+        it (``_staging_donation``); callers must not reuse them after the
+        dispatch (the run loop never does — each segment is staged
+        fresh)."""
         import jax
         import jax.numpy as jnp
 
         nc = self._n_confusion()
+        compiles = self._m_compiles
+        donate = (0, 1, 3, 4) if self._staging_donation() else (0, 1)
 
         def chunk(params, velocities, hypers_mat, data_seg, tgt_seg,
                   bs_vec, base_key, step_nums):
+            compiles.inc()
             (p, v, conf_sum), ms = jax.lax.scan(
                 self._train_body(base_key, lambda xs: xs),
                 (params, velocities, jnp.zeros((nc, nc), jnp.int32)),
                 (data_seg, tgt_seg, bs_vec, step_nums, hypers_mat))
             return p, v, ms, conf_sum
 
-        return jax.jit(chunk, donate_argnums=(0, 1))
+        return jax.jit(chunk, donate_argnums=donate)
 
     def make_eval_scan_direct(self):
         import jax
         import jax.numpy as jnp
 
         nc = self._n_confusion()
+        compiles = self._m_compiles
 
         @jax.jit
         def chunk(params, data_seg, tgt_seg, bs_vec):
+            compiles.inc()
+
             def unpack(xs):
                 data, tgt, bs = xs
                 return self._decode(data), tgt, bs
@@ -974,11 +1099,16 @@ class FusedTrainer:
 
     def make_train_step_direct(self):
         """Tail-update twin of ``make_train_step`` for staged (1, B, ...)
-        minibatch tensors."""
+        minibatch tensors.  NO data donation here: the tail path feeds
+        the same staged buffers to the eval step first and (gd_skip
+        permitting) this step second."""
         import jax
+
+        compiles = self._m_compiles
 
         def step(params, velocities, hypers, data_seg, tgt_seg,
                  batch_size, key):
+            compiles.inc()
             return self._update_core(params, velocities, hypers,
                                      data_seg[0], tgt_seg[0], batch_size,
                                      key)
@@ -989,8 +1119,11 @@ class FusedTrainer:
         import jax
         from functools import partial
 
+        compiles = self._m_compiles
+
         @partial(jax.jit, static_argnums=(5,))
         def step(params, data_seg, tgt_seg, batch_size, key, train):
+            compiles.inc()
             _, metrics = self.loss_and_metrics(
                 params, self._decode(data_seg[0]), tgt_seg[0], batch_size,
                 key, train=train)
@@ -1145,6 +1278,84 @@ class FusedTrainer:
         look_mbs = prefetch_segments * max(self.scan_chunk, 1)
         sel_cache = {}
 
+        # -- async double-buffered device staging (ISSUE 7): a one-worker
+        # stager assembles + device_puts the NEXT train segment while the
+        # current one computes, so host gather/decode and the H2D copy
+        # hide under the step instead of serializing against it.  The
+        # prediction is the dispatch loop's own segment-collection rule
+        # replayed over the lookahead fifo; a mispredicted segment falls
+        # back to inline staging (counted — never wrong data).  Single-
+        # controller only: the multi-process gather-own-rows callback
+        # stays on the training thread.
+        stager = None
+        if staging and bool(root.common.engine.get("async_staging", True)):
+            import jax as _jax
+
+            if self.mesh is None or _jax.process_count() == 1:
+                from znicz_tpu.loader.ingest import DeviceStager
+
+                stager = DeviceStager(
+                    lambda rows: self._stage_direct(rows, put))
+                self._stager = stager       # observable (tests, bench)
+        # the lookahead must advance even for memcpy-cheap sources (no
+        # decode pool): the stager needs the fifo to predict from
+        look_mbs = max(look_mbs if can_prefetch else 0,
+                       2 * max(self.scan_chunk, 1) if stager else 0)
+
+        def stage_segment(seg):
+            """Staged device tensors for a dispatch group — from the
+            stager when armed (a predicted group is a cache pop; the
+            fallback assembles inline and counts a miss)."""
+            rows = [s["idx"] for s in seg]
+            if stager is not None:
+                return stager.take(rows)
+            return self._stage_direct(rows, put)
+
+        def upcoming_segments():
+            """The dispatch groups the loop WILL form from the fifo — the
+            segment-collection rules replayed without consuming: TRAIN
+            segments (consecutive non-tail, up to scan_chunk), eval runs
+            (same class, up to scan_chunk), the tail as its own group.
+            Stops at the first group whose boundary the fifo cannot
+            prove yet (the lookahead refill will)."""
+            from znicz_tpu.loader.base import TRAIN as _TRAIN
+
+            groups, i, n = [], 0, len(fifo)
+            while i < n:
+                m = fifo[i]
+                if m["class"] == _TRAIN and m["last_minibatch"]:
+                    groups.append([m])          # the tail dispatches alone
+                    i += 1
+                    continue
+                is_train = m["class"] == _TRAIN
+                scan = self._train_scan if is_train else self._eval_scan
+                cap = self.scan_chunk if scan else 1
+                seg = [m]
+                i += 1
+                while i < n and len(seg) < cap:
+                    nxt = fifo[i]
+                    same = (nxt["class"] == _TRAIN
+                            and not nxt["last_minibatch"]
+                            if is_train else nxt["class"] == m["class"])
+                    if not same:
+                        break
+                    seg.append(nxt)
+                    i += 1
+                if len(seg) < cap and i >= n:
+                    break                       # boundary not proven
+                groups.append(seg)
+            return groups
+
+        def submit_upcoming():
+            """Start staging the provable upcoming groups, oldest first,
+            until the ping-pong is full (``stager.depth``)."""
+            if stager is None:
+                return
+            for seg in upcoming_segments():
+                if stager.outstanding >= stager.depth:
+                    break
+                stager.submit([s["idx"] for s in seg])
+
         def local_rows(idx):
             """The rows of a minibatch THIS process will stage (multi-
             controller prefetch keeps _stage_direct's gather-own-rows-
@@ -1176,19 +1387,21 @@ class FusedTrainer:
             return fifo.popleft() if fifo else self._advance()
 
         def extend_lookahead():
-            if not can_prefetch:
+            if not (can_prefetch or stager is not None):
                 return
             # a put-back mb (segment collection overshoot) may sit in the
             # fifo without having been submitted — cover it first
-            for m in fifo:
-                if not m.get("pf"):
-                    loader.prefetch_rows(local_rows(m["idx"]))
-                    m["pf"] = True
+            if can_prefetch:
+                for m in fifo:
+                    if not m.get("pf"):
+                        loader.prefetch_rows(local_rows(m["idx"]))
+                        m["pf"] = True
             while len(fifo) < look_mbs and \
                     not (fifo and fifo[-1]["last_minibatch"]):
                 nxt = self._advance()
-                loader.prefetch_rows(local_rows(nxt["idx"]))
-                nxt["pf"] = True
+                if can_prefetch:
+                    loader.prefetch_rows(local_rows(nxt["idx"]))
+                    nxt["pf"] = True
                 fifo.append(nxt)
 
         def flush():
@@ -1248,6 +1461,15 @@ class FusedTrainer:
                             fifo.appendleft(nxt)
                             break
                     extend_lookahead()  # future segments' decode starts
+                    if stager is not None:
+                        # ping-pong ordering (ISSUE 7): upcoming groups'
+                        # assemblies are already in flight — sync the
+                        # PREVIOUS segment FIRST so its device compute
+                        # overlaps them, then take this segment's staged
+                        # buffers (ready by then; the wait histogram is
+                        # the proof the --ingest gate checks)
+                        submit_upcoming()
+                        flush()
                     gen = prng.get("fused_trainer")
 
                     def seg_ops():
@@ -1265,9 +1487,11 @@ class FusedTrainer:
                     with self._telemetry.step_annotation(step0):
                         if staging:
                             # staged-direct: minibatches ride in the scan xs
-                            # (even a lone step goes through the K=1 scan)
-                            dseg, tseg = self._stage_direct(
-                                [s["idx"] for s in seg], put)
+                            # (even a lone step goes through the K=1 scan);
+                            # with the async stager the buffers were
+                            # assembled + put while the PREVIOUS segment
+                            # computed
+                            dseg, tseg = stage_segment(seg)
                             bs_vec, steps = seg_ops()
                             params, velocities, ms, conf_sum = \
                                 self._train_scan(
@@ -1299,7 +1523,12 @@ class FusedTrainer:
                             _time.perf_counter() - t_disp,
                             {"steps": len(seg), "step0": step0})
                     self.steps_done += len(seg)
-                    flush()             # previous segment, AFTER dispatch
+                    # start staging the NEXT groups before anything
+                    # blocks: their host assembly + H2D overlap this
+                    # segment's compute
+                    submit_upcoming()
+                    if stager is None:
+                        flush()         # previous segment, AFTER dispatch
                     inflight = (seg, result[0], result[1], t_iter)
                 elif is_train:
                     flush()
@@ -1310,7 +1539,7 @@ class FusedTrainer:
                     bs = np.int32(mb["size"])
                     key = prng.get("fused_trainer").jax_key(self.steps_done)
                     if staging:
-                        dseg, tseg = self._stage_direct([mb["idx"]], put)
+                        dseg, tseg = stage_segment([mb])
                         loss, n_err, conf = self._eval_step(
                             params, dseg, tseg, bs, key, True)
                     else:
@@ -1357,9 +1586,13 @@ class FusedTrainer:
                             fifo.appendleft(nxt)
                             break
                     extend_lookahead()
+                    # the upcoming groups stage while this eval segment
+                    # computes (the eval/train boundary is where each
+                    # epoch's first train segment would otherwise pay
+                    # the full assembly inline)
+                    submit_upcoming()
                     if staging:
-                        dseg, tseg = self._stage_direct(
-                            [s["idx"] for s in seg], put)
+                        dseg, tseg = stage_segment(seg)
                         bs_vec = put(np.array([s["size"] for s in seg],
                                               np.int32))
                         ms, conf_sum = self._eval_scan(
@@ -1406,10 +1639,16 @@ class FusedTrainer:
                     # loader already advanced (and reshuffled) into the
                     # next epoch — resume parity depends on this ordering
                     extend_lookahead()
+                    submit_upcoming()
             flush()
             self.writeback(params, velocities)
         finally:
             loader.indices_only = was_indices_only
+            if stager is not None:
+                # drop any mispredicted in-flight segment (a stop can
+                # land mid-prediction); staged buffers are just arrays —
+                # nothing to unwind
+                stager.close()
             # in the FINALLY: an interrupt mid-run must still land the
             # queued async saves (the writer thread is a daemon — without
             # this drain a Ctrl-C drops them); on the exception path the
