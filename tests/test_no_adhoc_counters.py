@@ -1,231 +1,219 @@
-"""CI lints: no NEW ad-hoc counter attributes (ISSUE 5 satellite), and
-no silently-ignored serving config knobs (ISSUE 6 satellite).
+"""CI lints, now riding znicz-lint (ISSUE 9): no NEW ad-hoc counter
+attributes (ISSUE 5 satellite) and no silently-ignored serving/engine
+config knobs (ISSUE 6/7 satellites).
 
-PRs 1-4 each grew bespoke ``self.<name> += 1`` counters (``bad_frames``,
-``prefetch_hits``, ``shed``, ...), readable only through whichever panel
-their owner happened to wire up.  ISSUE 5 moved them all into the
-telemetry registry (znicz_tpu/telemetry/), where every counter is
-exported uniformly on ``/metrics``.  This test greps the package for
-counter-suffixed bare increments so a future PR cannot regress into
-ad-hoc accounting: a new counter must either go through
-``telemetry.scope(...).counter(...)`` or be added to the ALLOWLIST
-below with a one-line justification.
+Historical note: these started as three hand-rolled regexes in this
+file.  The regexes were line-anchored (missed ``self.x = self.x + 1``)
+and blind to aliasing — binding a config subtree to a variable hid
+every later ``.get()`` read, so the lint had to REFUSE aliasing itself
+(the old ``SERVING_ALIAS`` / ``ENGINE_ALIAS`` patterns).  ISSUE 9
+ported all three onto the AST checkers in ``znicz_tpu/analysis/``:
+alias-bound reads now RESOLVE (see ``_admission_from_config`` in
+serving/frontend.py, which binds the admission subtree to a local),
+and the refusals are retired.  The test names survive; each is a thin
+wrapper over the corresponding analyzer rule.
+
+The counter ALLOWLIST (attributes that look counter-ish but are STATE,
+not metrics — e.g. ``parallel/fused.py steps_done``, the PRNG/step-key
+stream position; ``loader/base.py samples_served``, the loader cursor;
+the kohonen epoch accumulators) moved WITH its rationale comments to
+``znicz_tpu/analysis/counters.py`` so the ``python -m
+znicz_tpu.analysis`` CLI and this test share one source of truth;
+``test_allowlist_is_the_single_source_of_truth`` below pins the
+historical entries so they cannot silently vanish.
 """
 
 import pathlib
-import re
+import textwrap
+
+from znicz_tpu.analysis import run
+from znicz_tpu.analysis.config_knob import (ConfigKnobChecker,
+                                            load_declared_tables)
+from znicz_tpu.analysis.counters import (ALLOWLIST,
+                                         CounterRegistryChecker)
+from znicz_tpu.analysis.core import Module
 
 PKG = pathlib.Path(__file__).resolve().parent.parent / "znicz_tpu"
 
-#: attribute-name suffixes that mean "this is a counter": the union of
-#: every counter name the registry migration absorbed, so the regression
-#: class is exactly "a counter like the ones we already centralized"
-SUFFIXES = ("count", "total", "hits", "frames", "saves", "done",
-            "requeued", "reconnects", "replies", "registrations",
-            "updates", "rejected", "shed", "oversized", "compiles",
-            "received", "served", "batches", "errors", "resends")
 
-PATTERN = re.compile(
-    r"^\s*self\.(?P<name>[a-z0-9_]*(?:" + "|".join(SUFFIXES)
-    + r"))\s*\+=", re.M)
+def _check(checker, code, rel="fixture.py"):
+    """Run one checker over a fixture snippet."""
+    module = Module(pathlib.Path(rel), rel, textwrap.dedent(code))
+    return [f.message for f in checker.check(module)]
 
-#: (path-relative-to-znicz_tpu, attribute) pairs that look counter-ish
-#: but are STATE, not metrics — each with its reason
-ALLOWLIST = {
-    # PRNG/step-key stream position: training semantics (jax_key(step)),
-    # not accounting; mirrored into the registry as trainer/train_steps
-    ("parallel/fused.py", "steps_done"),
-    # loader cursor over the resident set (drives epoch bookkeeping)
-    ("loader/base.py", "samples_served"),
-    # graphics PUB/SUB frame cursor on the plotting side-channel
-    ("graphics.py", "received"),
-    # kohonen epoch accumulators (averaged into qerror / the winners
-    # histogram, then reset)
-    ("kohonen.py", "_batches"),
-    ("kohonen.py", "total"),
-}
+
+def _live(rule):
+    """Unbaselined findings of one rule over the real package."""
+    analysis = run(PKG, rules=[rule])
+    assert not analysis.parse_errors, analysis.parse_errors
+    return [f.render() for f in analysis.findings]
+
+
+# -- ad-hoc counter lint (ISSUE 5 satellite) -----------------------------------
 
 
 def test_no_adhoc_counters_outside_the_registry():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        if rel.startswith("telemetry/"):
-            continue                    # the registry implements itself
-        text = path.read_text()
-        for m in PATTERN.finditer(text):
-            name = m.group("name")
-            if (rel, name) in ALLOWLIST:
-                continue
-            line = text.count("\n", 0, m.start()) + 1
-            offenders.append(f"{rel}:{line}: self.{name} += ...")
+    offenders = _live("counter-registry")
     assert not offenders, (
         "ad-hoc counter increments found — register them in "
         "znicz_tpu/telemetry instead (telemetry.scope(...).counter(...)"
-        ".inc()), or allowlist non-metric state with a justification:\n  "
-        + "\n  ".join(offenders))
+        ".inc()), or allowlist non-metric state with a justification in "
+        "znicz_tpu/analysis/counters.py:\n  " + "\n  ".join(offenders))
 
 
 def test_lint_pattern_catches_the_regression_class():
-    """The pattern must actually fire on the style it polices."""
-    assert PATTERN.search("        self.bad_frames += 1")
-    assert PATTERN.search("self.retry_count += n")
-    assert not PATTERN.search("self._pos += 1")          # cursor, not metric
-    assert not PATTERN.search("unit.run_count += 1")     # not self.
+    """The checker must actually fire on the style it polices — and on
+    the ``self.x = self.x + 1`` spelling the old regex never saw."""
+    checker = CounterRegistryChecker(allowlist=())
+    tp = _check(checker, """
+        class S:
+            def f(self):
+                self.bad_frames += 1
+                self.retry_count += n
+                self.bad_frames = self.bad_frames + 1   # regex blind spot
+                if fast: self.served += 1               # one-liner too
+    """)
+    assert len(tp) == 4, tp
+    tn = _check(checker, """
+        class S:
+            def f(self):
+                self._pos += 1                  # cursor, not metric
+                unit.run_count += 1             # not self.
+                self.total = other.total + 1    # copy, not increment
+    """)
+    assert not tn, tn
+
+
+def test_allowlist_is_the_single_source_of_truth():
+    """The historical allowlist entries (with their reasons) moved to
+    the checker module; pin them so they cannot silently vanish."""
+    for pair in {("parallel/fused.py", "steps_done"),
+                 ("loader/base.py", "samples_served"),
+                 ("graphics.py", "received"),
+                 ("kohonen.py", "_batches"),
+                 ("kohonen.py", "total")}:
+        assert pair in ALLOWLIST, pair
+    # and every allowlisted site still exists in the package — a stale
+    # allowlist entry is a hole waiting for a regression to crawl in
+    for rel, attr in ALLOWLIST:
+        text = (PKG / rel).read_text()
+        assert f"self.{attr}" in text, (rel, attr)
 
 
 # -- serving config-knob lint (ISSUE 6 satellite) ------------------------------
-#
-# A ``root.common.serving.*`` read whose key is missing from the serving
-# DEFAULTS table is config the service will silently ignore under the
-# dotted-override CLI (the Config tree autovivifies, so a typo'd or
-# undeclared knob reads as its default forever, no error).  Every key
-# the package reads must be declared in serving/frontend.py DEFAULTS.
-
-SERVING_CFG = re.compile(
-    r"root\.common\.serving\b(?P<chain>(?:\.get\(\s*\"\w+\"|\.\w+)*)")
-
-#: binding a serving config SUBTREE to a variable (``node =
-#: root.common.serving.admission``) hides every ``node.get("key")``
-#: read from the textual lint above — refuse the aliasing itself and
-#: force literal chains at each read site
-SERVING_ALIAS = re.compile(
-    r"(?<![=!<>])=\s*root\.common\.serving(?:\.[A-Za-z_]\w*)*\s*(?:#.*)?$",
-    re.M)
-
-#: extracts the dotted key path from one matched access chain; a bare
-#: ``.get(variable`` contributes nothing (the frontend's _cfg helper is
-#: keyed off DEFAULTS by construction)
-_CHAIN_TOKEN = re.compile(r'\.get\(\s*"(\w+)"|\.(\w+)')
 
 
-def _chain_key(chain: str):
-    tokens = [lit or attr for lit, attr in _CHAIN_TOKEN.findall(chain)
-              if (lit or attr) != "get"]
-    return ".".join(tokens)
+def test_every_serving_config_read_is_declared_in_defaults():
+    offenders = _live("config-knob")
+    assert not offenders, (
+        "config keys read in code but missing from the declaration "
+        "tables — an undeclared knob is silently ignored by dotted "
+        "overrides; declare it (or fix the typo):\n  "
+        + "\n  ".join(offenders))
 
 
-def _flat_defaults():
+def test_serving_config_lint_catches_the_regression_class():
+    """Undeclared keys fire (literal OR alias-bound), declared keys and
+    the dynamic ``.get(variable)`` read stay quiet."""
+    checker = ConfigKnobChecker(PKG)
+    assert _check(checker, """
+        from znicz_tpu.core.config import root
+        x = root.common.serving.get("bogus_knob", 1)
+    """)
+    assert not _check(checker, """
+        from znicz_tpu.core.config import root
+        x = root.common.serving.get("max_batch", 32)
+        y = root.common.serving.admission.get("rate_limit", 0)
+    """)
+    # the frontend's dynamic read (variable key) contributes no path
+    assert not _check(checker, """
+        from znicz_tpu.core.config import root
+        def _cfg(name):
+            return root.common.serving.get(name, DEFAULTS[name])
+    """)
+    # ALIASING NOW RESOLVES (the old lint refused it outright): a
+    # declared read through the alias passes, a typo through it fires
+    assert not _check(checker, """
+        from znicz_tpu.core.config import root
+        def f():
+            adm = root.common.serving.admission
+            return adm.get("rate_limit", 0)
+    """)
+    offenders = _check(checker, """
+        from znicz_tpu.core.config import root
+        def f():
+            adm = root.common.serving.admission
+            return adm.get("rate_limi", 0)
+    """)
+    assert offenders and "admission.rate_limi" in offenders[0]
+    # what alias resolution CANNOT follow — a subtree escaping the
+    # local scope — is still refused, preserving the old guarantee
+    assert _check(checker, """
+        from znicz_tpu.core.config import root
+        def f(g):
+            g(root.common.serving.admission)
+    """)
+
+
+# -- engine config-knob lint (ISSUE 7 satellite) -------------------------------
+
+
+def test_every_engine_config_read_is_declared_in_defaults():
+    # same analyzer rule covers both trees; the package-wide run in
+    # test_every_serving_config_read_is_declared_in_defaults already
+    # proves zero live findings — here we pin the engine table contents
+    # the old test asserted, plus the AST-extracted tables matching the
+    # imported Python ones (table-extraction rot guard)
+    tables = load_declared_tables(PKG)
+    from znicz_tpu.core.config import ENGINE_DEFAULTS
     from znicz_tpu.serving.frontend import DEFAULTS
 
-    def walk(d, prefix=""):
+    assert tables["engine"][0] == set(ENGINE_DEFAULTS)
+
+    def flat(d, prefix=""):
         out = set()
         for k, v in d.items():
             out.add(prefix + k)
             if isinstance(v, dict):
-                out |= walk(v, prefix + k + ".")
+                out |= flat(v, prefix + k + ".")
         return out
 
-    return walk(DEFAULTS)
-
-
-def test_every_serving_config_read_is_declared_in_defaults():
-    declared = _flat_defaults()
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        text = path.read_text()
-        for m in SERVING_CFG.finditer(text):
-            key = _chain_key(m.group("chain"))
-            if key and key not in declared:
-                line = text.count("\n", 0, m.start()) + 1
-                offenders.append(
-                    f"{rel}:{line}: root.common.serving.{key}")
-        for m in SERVING_ALIAS.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            offenders.append(
-                f"{rel}:{line}: serving config subtree bound to a "
-                f"variable — later .get() reads are invisible to this "
-                f"lint; spell the literal chain at each read site")
-    assert not offenders, (
-        "serving config keys read in code but missing from the serving "
-        "DEFAULTS table (znicz_tpu/serving/frontend.py) — an undeclared "
-        "knob is silently ignored by dotted overrides; declare it (or "
-        "fix the typo):\n  " + "\n  ".join(offenders))
-
-
-# -- engine config-knob lint (ISSUE 7 satellite) -------------------------------
-#
-# Same regression class as the serving lint above, for the tree where
-# this PR's knobs land (``compute_dtype``, ``fused_tail``,
-# ``async_staging``, ``staging_donate``, ``xla_latency_hiding``): every
-# literal ``root.common.engine.*`` read in the package must be declared
-# in core/config.py ENGINE_DEFAULTS, and the subtree must never be bound
-# to a variable (which would hide later ``.get()`` reads from the lint).
-
-ENGINE_CFG = re.compile(
-    r"root\.common\.engine\b(?P<chain>(?:\.get\(\s*\"\w+\"|\.\w+)*)")
-
-ENGINE_ALIAS = re.compile(
-    r"(?<![=!<>])=\s*root\.common\.engine\s*(?:#.*)?$", re.M)
-
-
-def _engine_defaults():
-    from znicz_tpu.core.config import ENGINE_DEFAULTS
-
-    return set(ENGINE_DEFAULTS)
-
-
-def test_every_engine_config_read_is_declared_in_defaults():
-    declared = _engine_defaults()
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        text = path.read_text()
-        for m in ENGINE_CFG.finditer(text):
-            key = _chain_key(m.group("chain"))
-            if key and key not in declared:
-                line = text.count("\n", 0, m.start()) + 1
-                offenders.append(
-                    f"{rel}:{line}: root.common.engine.{key}")
-        for m in ENGINE_ALIAS.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            offenders.append(
-                f"{rel}:{line}: engine config subtree bound to a "
-                f"variable — later .get() reads are invisible to this "
-                f"lint; spell the literal chain at each read site")
-    assert not offenders, (
-        "engine config keys read in code but missing from "
-        "ENGINE_DEFAULTS (znicz_tpu/core/config.py) — an undeclared "
-        "knob is silently ignored by dotted overrides; declare it (or "
-        "fix the typo):\n  " + "\n  ".join(offenders))
+    assert tables["serving"][0] | tables["serving"][1] == flat(DEFAULTS)
 
 
 def test_engine_config_lint_catches_the_regression_class():
-    m = ENGINE_CFG.search('root.common.engine.get("bogus_knob", 1)')
-    assert _chain_key(m.group("chain")) == "bogus_knob"
-    assert "bogus_knob" not in _engine_defaults()
-    m = ENGINE_CFG.search('root.common.engine.compute_dtype = "bf16"')
-    assert _chain_key(m.group("chain")) == "compute_dtype"
+    checker = ConfigKnobChecker(PKG)
+    assert _check(checker, """
+        from znicz_tpu.core.config import root
+        x = root.common.engine.get("bogus_knob", 1)
+    """)
+    # a WRITE of an undeclared key is an offense too (sample configs
+    # SET knobs the engine later reads)
+    assert _check(checker, """
+        from znicz_tpu.core.config import root
+        root.common.engine.compute_dtyp = "bf16"
+    """)
+    assert not _check(checker, """
+        from znicz_tpu.core.config import root
+        root.common.engine.compute_dtype = "bf16"
+        chunk = root.common.engine.get("scan_chunk", 8)
+        if x == root.common.engine:
+            pass
+    """)
     for key in ("compute_dtype", "fused_tail", "async_staging",
                 "staging_donate", "xla_latency_hiding", "scan_chunk"):
-        assert key in _engine_defaults(), key
-    # aliasing the subtree is itself an offense; literal reads are not
-    assert ENGINE_ALIAS.search("eng = root.common.engine")
-    assert not ENGINE_ALIAS.search(
-        'chunk = root.common.engine.get("scan_chunk", 8)')
-    assert not ENGINE_ALIAS.search(
-        "if x == root.common.engine:")
-
-
-def test_serving_config_lint_catches_the_regression_class():
-    """The lint must fire on undeclared keys and stay quiet on
-    declared ones and on the dynamic _cfg read."""
-    m = SERVING_CFG.search('root.common.serving.get("bogus_knob", 1)')
-    assert _chain_key(m.group("chain")) == "bogus_knob"
-    assert "bogus_knob" not in _flat_defaults()
-    m = SERVING_CFG.search(
-        'root.common.serving.admission.get("rate_limit", 0)')
-    assert _chain_key(m.group("chain")) == "admission.rate_limit"
-    assert "admission.rate_limit" in _flat_defaults()
-    assert "max_batch" in _flat_defaults()
-    # the frontend's dynamic read (variable key) contributes no path
-    m = SERVING_CFG.search("root.common.serving.get(name, DEFAULTS[name])")
-    assert _chain_key(m.group("chain")) == ""
-    # aliasing a subtree is itself an offense; a .get READ is not
-    assert SERVING_ALIAS.search("node = root.common.serving.admission")
-    assert SERVING_ALIAS.search("x = root.common.serving  # comment")
-    assert not SERVING_ALIAS.search(
-        'web_port = root.common.serving.get("web_port", None)')
-    assert not SERVING_ALIAS.search(
-        "if x == root.common.serving.admission:")
+        assert key in load_declared_tables(PKG)["engine"][0], key
+    # engine-tree aliasing resolves now as well
+    assert not _check(checker, """
+        from znicz_tpu.core.config import root
+        def f():
+            eng = root.common.engine
+            return eng.get("scan_chunk", 8)
+    """)
+    offenders = _check(checker, """
+        from znicz_tpu.core.config import root
+        def f():
+            eng = root.common.engine
+            return eng.get("scan_chunky", 8)
+    """)
+    assert offenders and "scan_chunky" in offenders[0]

@@ -1,0 +1,28 @@
+"""znicz-lint: AST static analysis tuned to this stack (ISSUE 9).
+
+Four rules over one shared AST walk of ``znicz_tpu/``:
+
+  - ``thread-shared-state`` — attributes mutated on a worker thread and
+    accessed elsewhere with no enclosing lock (the PR 6/7
+    review-hardening bug class, automated);
+  - ``jit-purity``         — Python side effects, tracer leaks, and
+    recompile hazards inside jit/custom_vjp/pallas traced functions;
+  - ``config-knob``        — every ``root.common.{engine,serving}.*``
+    read/write resolved through local aliases and checked against the
+    declared DEFAULTS tables;
+  - ``counter-registry``   — no new ad-hoc ``self.<counter> += 1``
+    outside the telemetry registry.
+
+Run ``python -m znicz_tpu.analysis`` (add ``--json`` for dashboards).
+Suppress one site with ``# znicz: ignore[rule]``; accept a triaged
+finding by adding it to ``znicz_tpu/analysis/baseline.json`` with a
+one-line reason.  The tier-1 gate (tests/test_analysis.py) fails on any
+unbaselined finding.
+"""
+
+from .core import (Analysis, Checker, DEFAULT_BASELINE, Finding, Module,
+                   collect_modules, default_checkers, load_baseline, run)
+
+__all__ = ["Analysis", "Checker", "DEFAULT_BASELINE", "Finding",
+           "Module", "collect_modules", "default_checkers",
+           "load_baseline", "run"]

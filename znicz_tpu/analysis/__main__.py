@@ -1,0 +1,60 @@
+"""CLI: ``python -m znicz_tpu.analysis [paths] [--json] [--rules ...]``.
+
+Exit status 0 when the scan is clean (zero unbaselined findings), 1
+otherwise — suitable as a CI gate.  ``--json`` emits one machine-
+readable document (findings + per-rule counts + baselined/suppressed
+totals) so benches and dashboards can track finding counts over time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import DEFAULT_BASELINE, run
+
+PKG_DIR = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m znicz_tpu.analysis",
+        description="znicz-lint: AST static analysis for znicz_tpu "
+                    "(thread-safety, JAX tracer hygiene, config/counter "
+                    "discipline)")
+    parser.add_argument(
+        "paths", nargs="*", type=pathlib.Path,
+        help="files or directories to scan (default: the znicz_tpu "
+             "package)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON document instead of text")
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated subset of rules to run (default: all)")
+    parser.add_argument(
+        "--baseline", default=str(DEFAULT_BASELINE),
+        help="baseline file of triaged-and-accepted findings; pass "
+             "'none' to disable and see everything")
+    args = parser.parse_args(argv)
+
+    baseline = None if args.baseline == "none" \
+        else pathlib.Path(args.baseline)
+    rules = [r.strip() for r in args.rules.split(",")] \
+        if args.rules else None
+    try:
+        analysis = run(PKG_DIR, rules=rules, baseline_path=baseline,
+                       paths=args.paths or None)
+    except ValueError as exc:
+        parser.error(str(exc))
+
+    if args.json:
+        print(json.dumps(analysis.to_json(), indent=2))
+    else:
+        print(analysis.render_text())
+    return 0 if analysis.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

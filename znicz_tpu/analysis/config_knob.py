@@ -1,0 +1,381 @@
+"""config-knob: every engine/serving config read must be declared.
+
+The ``Config`` tree autovivifies: a typo'd or undeclared
+``root.common.engine.<knob>`` read silently returns its fallback
+forever, and dotted CLI overrides of it are silently ignored.  PR 6 and
+PR 7 added regex lints forcing every literal ``root.common.serving.*`` /
+``root.common.engine.*`` chain into the DEFAULTS declaration tables —
+but the regexes were blind to aliasing, so they REFUSED subtree
+aliasing outright (``adm = root.common.serving.admission`` was itself an
+offense).  This checker is the AST-accurate generalization that retires
+that workaround: it resolves attribute/``.get`` chains *through local
+aliases* and checks the resulting dotted key against the declared
+tables, which are themselves read from the AST of
+``core/config.py`` (``ENGINE_DEFAULTS``) and ``serving/frontend.py``
+(``DEFAULTS``) — no jax import needed to lint.
+
+Resolved and checked:
+
+  - literal chains: ``root.common.engine.fuse``,
+    ``root.common.serving.admission.get("rate_limit", d)``;
+  - aliased chains: ``adm = root.common.serving.admission`` then
+    ``adm.get("rate_limit", d)`` (aliases of aliases too);
+  - writes: ``root.common.engine.foo = 1`` needs ``foo`` declared just
+    like a read (sample configs SET knobs the engine later reads).
+
+Deliberately silent (the true negatives):
+
+  - dynamic reads ``node.get(name, ...)`` with a non-literal key — the
+    frontend's ``_cfg`` helper is keyed off DEFAULTS by construction;
+  - Config's own dict-ish methods (``update``/``items``/...);
+  - trees other than ``common.engine`` / ``common.serving``.
+
+Still flagged: a subtree that ESCAPES local analysis (stored on an
+object, passed to a call, returned) — reads beyond that point would be
+invisible to the lint, which is the hole the old blanket alias refusal
+plugged.  Spell reads locally, or baseline the escape with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module
+
+RULE = "config-knob"
+
+#: dict-ish methods of Config that take no literal key / are not reads
+_CONFIG_METHODS = {"update", "items", "keys", "values", "flat",
+                   "snapshot", "restore", "as_dict", "to_dict",
+                   "set_by_path"}
+
+_TREES = {("common", "engine"): "engine",
+          ("common", "serving"): "serving"}
+
+Path = Tuple[str, ...]
+
+
+def _dict_tables(node: ast.Dict, prefix: str = ""
+                 ) -> Tuple[Set[str], Set[str]]:
+    """(leaf keys, subtree keys) of a (possibly nested) dict literal,
+    dotted-flattened."""
+    leaves: Set[str] = set()
+    subtrees: Set[str] = set()
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        dotted = prefix + k.value
+        if isinstance(v, ast.Dict):
+            subtrees.add(dotted)
+            sub_leaves, sub_trees = _dict_tables(v, dotted + ".")
+            leaves |= sub_leaves
+            subtrees |= sub_trees
+        else:
+            leaves.add(dotted)
+    return leaves, subtrees
+
+
+def load_declared_tables(pkg_dir: pathlib.Path
+                         ) -> Dict[str, Tuple[Set[str], Set[str]]]:
+    """AST-extract the declaration tables: ``ENGINE_DEFAULTS`` from
+    core/config.py and ``DEFAULTS`` from serving/frontend.py.  Returns
+    {tree: (leaf keys, subtree keys)}."""
+    sources = {"engine": (pkg_dir / "core" / "config.py",
+                          "ENGINE_DEFAULTS"),
+               "serving": (pkg_dir / "serving" / "frontend.py",
+                           "DEFAULTS")}
+    out: Dict[str, Tuple[Set[str], Set[str]]] = {}
+    for tree, (path, var) in sources.items():
+        leaves: Set[str] = set()
+        subtrees: Set[str] = set()
+        if path.exists():
+            mod = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(mod):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Dict)
+                        and any(isinstance(t, ast.Name) and t.id == var
+                                for t in node.targets)):
+                    leaves, subtrees = _dict_tables(node.value)
+                    break
+        out[tree] = (leaves, subtrees)
+    return out
+
+
+class _ScopeWalker:
+    """Statement-ordered walk of one scope, carrying the alias
+    environment {local name -> absolute config path}."""
+
+    def __init__(self, checker: "ConfigKnobChecker", module: Module,
+                 out: List[Finding]) -> None:
+        self.checker = checker
+        self.module = module
+        self.out = out
+        self._scope = "module"      # "module" | "class" | "function"
+
+    # -- path resolution -----------------------------------------------------
+
+    def resolve_ref(self, expr: ast.expr, env: Dict[str, Path]
+                    ) -> Optional[Path]:
+        """Pure attribute chain -> absolute path from ``root``."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "root":
+                return ()
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve_ref(expr.value, env)
+            if base is not None:
+                return base + (expr.attr,)
+        return None
+
+    def _tree_of(self, path: Path) -> Optional[Tuple[str, Path]]:
+        for prefix, tree in _TREES.items():
+            if path[:2] == prefix:
+                return tree, path[2:]
+        return None
+
+    def _classify(self, path: Path) -> str:
+        """'outside' | 'subtree' | 'leaf' | 'undeclared' for a full
+        absolute path."""
+        hit = self._tree_of(path)
+        if hit is None:
+            # root / root.common / unrelated trees: track, never flag
+            return "outside"
+        tree, keys = hit
+        if not keys:
+            return "subtree"
+        leaves, subtrees = self.checker.tables[tree]
+        dotted = ".".join(keys)
+        if dotted in subtrees:
+            return "subtree"
+        if dotted in leaves:
+            return "leaf"
+        return "undeclared"
+
+    def _check_access(self, path: Path, line: int) -> None:
+        hit = self._tree_of(path)
+        if hit is None:
+            return
+        tree, keys = hit
+        if not keys:
+            return
+        dotted = ".".join(keys)
+        leaves, subtrees = self.checker.tables[tree]
+        if dotted not in leaves and dotted not in subtrees:
+            table = ("ENGINE_DEFAULTS (znicz_tpu/core/config.py)"
+                     if tree == "engine" else
+                     "serving DEFAULTS (znicz_tpu/serving/frontend.py)")
+            self.out.append(Finding(
+                RULE, self.module.rel, line,
+                f"undeclared {tree} config key "
+                f"'root.common.{tree}.{dotted}' — missing from {table}; "
+                f"an undeclared knob is silently ignored by dotted "
+                f"overrides (declare it or fix the typo)"))
+
+    def _escape(self, path: Path, line: int, how: str) -> None:
+        hit = self._tree_of(path)
+        if hit is None:
+            return
+        tree, keys = hit
+        dotted = ".".join(("root", "common", tree) + tuple(keys))
+        self.out.append(Finding(
+            RULE, self.module.rel, line,
+            f"config subtree '{dotted}' {how} — reads beyond this "
+            f"point are invisible to the lint; keep reads on local "
+            f"aliases or literal chains"))
+
+    # -- expressions ---------------------------------------------------------
+
+    def walk_expr(self, expr: ast.expr, env: Dict[str, Path]) -> None:
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Attribute):
+                base = self.resolve_ref(func.value, env)
+                if base is not None and self._tree_of(base) is not None:
+                    if func.attr == "get":
+                        key = expr.args[0] if expr.args else None
+                        if isinstance(key, ast.Constant) and isinstance(
+                                key.value, str):
+                            self._check_access(base + (key.value,),
+                                               expr.lineno)
+                        # dynamic key: contributes nothing by design
+                        for arg in expr.args[1:]:
+                            self.walk_expr(arg, env)
+                        for kw in expr.keywords:
+                            self.walk_expr(kw.value, env)
+                        return
+                    if func.attr in _CONFIG_METHODS:
+                        for arg in expr.args:
+                            self.walk_expr(arg, env)
+                        for kw in expr.keywords:
+                            self.walk_expr(kw.value, env)
+                        return
+            self.walk_expr(func, env)
+            for arg in list(expr.args) + [kw.value
+                                          for kw in expr.keywords]:
+                ref = self.resolve_ref(arg, env)
+                if ref is not None and self._classify(ref) == "subtree":
+                    self._escape(ref, arg.lineno,
+                                 "passed as a call argument")
+                else:
+                    self.walk_expr(arg, env)
+            return
+        if isinstance(expr, ast.Attribute):
+            ref = self.resolve_ref(expr, env)
+            if ref is not None:
+                if self._classify(ref) in ("leaf", "undeclared"):
+                    self._check_access(ref, expr.lineno)
+                # bare subtree in expression position (comparison,
+                # str(), ...) reads nothing — silent
+                return
+            self.walk_expr(expr.value, env)
+            return
+        if isinstance(expr, ast.Lambda):
+            self.walk_expr(expr.body, dict(env))
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.walk_expr(child, env)
+            elif isinstance(child, ast.comprehension):
+                self.walk_expr(child.iter, env)
+                for cond in child.ifs:
+                    self.walk_expr(cond, env)
+            elif isinstance(child, ast.keyword):
+                self.walk_expr(child.value, env)
+
+    # -- statements ----------------------------------------------------------
+
+    def walk_body(self, stmts: List[ast.stmt],
+                  env: Dict[str, Path]) -> None:
+        for stmt in stmts:
+            self.walk_stmt(stmt, env)
+
+    def _assign_value(self, targets: List[ast.expr], value: ast.expr,
+                      env: Dict[str, Path], lineno: int) -> None:
+        ref = self.resolve_ref(value, env)
+        kind = self._classify(ref) if ref is not None else None
+        if kind in ("outside", "subtree"):
+            for target in targets:
+                if isinstance(target, ast.Name) \
+                        and self._scope != "class":
+                    env[target.id] = ref      # a trackable alias
+                else:
+                    # class-body bindings are reachable as self.<name>
+                    # from any method — not locally trackable
+                    if kind == "subtree":
+                        self._escape(ref, lineno,
+                                     "stored outside the local scope")
+                    self._walk_target(target, env)
+            return
+        if kind in ("leaf", "undeclared"):
+            self._check_access(ref, lineno)   # value is a key READ
+        else:
+            self.walk_expr(value, env)
+        for target in targets:
+            if isinstance(target, ast.Name):
+                env.pop(target.id, None)      # rebound to a non-ref
+            else:
+                self._walk_target(target, env)
+
+    def _walk_target(self, target: ast.expr,
+                     env: Dict[str, Path]) -> None:
+        """Attribute-chain write targets are key accesses too."""
+        if isinstance(target, ast.Attribute):
+            ref = self.resolve_ref(target, env)
+            if ref is not None:
+                self._check_access(ref, target.lineno)
+                return
+            self.walk_expr(target.value, env)
+        elif isinstance(target, ast.Subscript):
+            self.walk_expr(target.value, env)
+            self.walk_expr(target.slice, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._walk_target(elt, env)
+
+    def walk_stmt(self, stmt: ast.stmt, env: Dict[str, Path]) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._assign_value(stmt.targets, stmt.value, env,
+                               stmt.lineno)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign_value([stmt.target], stmt.value, env,
+                                   stmt.lineno)
+            else:
+                self._walk_target(stmt.target, env)
+        elif isinstance(stmt, ast.AugAssign):
+            self._walk_target(stmt.target, env)
+            self.walk_expr(stmt.value, env)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                ref = self.resolve_ref(stmt.value, env)
+                if ref is not None and self._classify(ref) == "subtree":
+                    self._escape(ref, stmt.lineno,
+                                 "returned from the function")
+                else:
+                    self.walk_expr(stmt.value, env)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in stmt.decorator_list:
+                self.walk_expr(dec, env)
+            for default in (stmt.args.defaults
+                            + [d for d in stmt.args.kw_defaults if d]):
+                self.walk_expr(default, env)
+            outer, self._scope = self._scope, "function"
+            self.walk_body(stmt.body, dict(env))
+            self._scope = outer
+        elif isinstance(stmt, ast.ClassDef):
+            for dec in stmt.decorator_list:
+                self.walk_expr(dec, env)
+            outer, self._scope = self._scope, "class"
+            self.walk_body(stmt.body, dict(env))
+            self._scope = outer
+        else:
+            # generic: walk sub-statements in order (same env — flow-
+            # insensitive), and every expression child
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self.walk_stmt(child, env)
+                elif isinstance(child, ast.expr):
+                    self.walk_expr(child, env)
+                elif isinstance(child, (ast.excepthandler, ast.withitem,
+                                        ast.match_case)):
+                    for sub in ast.iter_child_nodes(child):
+                        if isinstance(sub, ast.stmt):
+                            self.walk_stmt(sub, env)
+                        elif isinstance(sub, ast.expr):
+                            self.walk_expr(sub, env)
+
+
+class ConfigKnobChecker(Checker):
+    name = RULE
+
+    def __init__(self, pkg_dir: pathlib.Path,
+                 tables: Optional[Dict[str, Tuple[Set[str], Set[str]]]]
+                 = None) -> None:
+        self.tables = tables if tables is not None \
+            else load_declared_tables(pathlib.Path(pkg_dir))
+
+    def check(self, module: Module):
+        out: List[Finding] = []
+        walker = _ScopeWalker(self, module, out)
+        # two phases, matching runtime semantics: module-level
+        # statements EXECUTE in order, but functions/classes are merely
+        # DEFINED then called after the module finishes — so defs are
+        # walked second, against the complete module alias env (a
+        # module-level alias textually below a def is still visible
+        # inside it)
+        env: Dict[str, Path] = {}
+        defs: List[ast.stmt] = []
+        for stmt in module.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                defs.append(stmt)
+            else:
+                walker.walk_stmt(stmt, env)
+        for stmt in defs:
+            walker.walk_stmt(stmt, env)
+        # the declaration tables declare; their own module assigns the
+        # documented defaults — those writes are leaf accesses and pass
+        # (declared), so no special-casing is needed here
+        return out

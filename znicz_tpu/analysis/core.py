@@ -1,0 +1,271 @@
+"""znicz-lint core: the shared AST walk, findings, pragmas, baseline.
+
+ISSUE 9 tentpole.  The package grew three regex lints
+(tests/test_no_adhoc_counters.py) that were blind to aliasing, and PR 6
+and PR 7 each needed a human review-hardening pass to catch the same
+defect class (unlocked shared state touched by a worker thread).  This
+module is the framework those checks now run on:
+
+  - every ``*.py`` under the target is parsed ONCE into a :class:`Module`
+    (source text + AST + suppression pragmas), shared by all checkers;
+  - checkers yield :class:`Finding` records ``(rule, path, line,
+    message, severity)``;
+  - a finding is suppressed either by an inline pragma
+    (``# znicz: ignore[rule]`` on the offending line or the line above)
+    or by an entry in the committed baseline file
+    (``znicz_tpu/analysis/baseline.json``) — the baseline is for
+    findings that were TRIAGED and accepted, each with a one-line
+    justification, so the tier-1 gate stays at zero *unbaselined*
+    findings while accepted debt remains visible and counted;
+  - baseline entries match on ``(rule, path, message)`` — deliberately
+    line-free, so unrelated edits that shift line numbers do not
+    invalidate the triage.
+
+Run it as ``python -m znicz_tpu.analysis`` (text) or with ``--json``
+(machine-readable counts for benches/dashboards).  The tier-1 test
+``tests/test_analysis.py::test_package_is_clean_under_the_analyzer``
+runs the same entry point in-process and fails on any unbaselined
+finding, making the analysis a standing gate rather than a one-off
+audit.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: inline suppression: ``# znicz: ignore[rule]`` or ``ignore[r1, r2]``,
+#: effective on its own line and on the line directly below it
+PRAGMA = re.compile(r"#\s*znicz:\s*ignore\[([A-Za-z0-9_\-, ]+)\]")
+
+#: default committed baseline, adjacent to this module
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker hit.  ``path`` is posix-relative to the scanned
+    package directory; ``key`` drops the line so baseline entries
+    survive unrelated line drift."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file shared by every checker: path, text,
+    AST, and the line -> suppressed-rules pragma map."""
+
+    def __init__(self, path: pathlib.Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        self.pragmas: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(text.splitlines(), 1):
+            m = PRAGMA.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.pragmas.setdefault(lineno, set()).update(rules)
+                if line.lstrip().startswith("#"):
+                    # a STANDALONE pragma comment covers the next line;
+                    # a trailing pragma covers only its own
+                    self.pragmas.setdefault(lineno + 1, set()).update(
+                        rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when a ``# znicz: ignore[rule]`` pragma sits on the
+        finding's line (trailing) or on a standalone comment line just
+        above it."""
+        return rule in self.pragmas.get(line, ())
+
+
+class Checker:
+    """Base: one rule, one ``check(module)`` pass.  Checkers needing
+    package-level context (the config DEFAULTS tables) receive the
+    package dir at construction."""
+
+    name = "abstract"
+
+    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Analysis:
+    """Result bundle of one run."""
+
+    findings: List[Finding]                 # live, unbaselined
+    baselined: List[Tuple[Finding, str]]    # (finding, justification)
+    pragma_suppressed: List[Finding]
+    stale_baseline: List[dict]              # entries that matched nothing
+    parse_errors: List[Finding]
+
+    @property
+    def clean(self) -> bool:
+        # stale baseline entries fail the gate too: a fixed-then-
+        # regressed finding must not reopen behind a dead entry
+        return (not self.findings and not self.parse_errors
+                and not self.stale_baseline)
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "clean": self.clean,
+            "counts": self.counts(),
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [dict(f.to_json(), reason=reason)
+                          for f, reason in self.baselined],
+            "pragma_suppressed": [f.to_json()
+                                  for f in self.pragma_suppressed],
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": [f.to_json() for f in self.parse_errors],
+        }
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.parse_errors + self.findings]
+        if self.clean:
+            lines.append("znicz-lint: clean")
+        per_rule = ", ".join(f"{rule}={n}"
+                             for rule, n in sorted(self.counts().items()))
+        lines.append(
+            f"znicz-lint: {len(self.findings)} finding(s)"
+            + (f" ({per_rule})" if per_rule else "")
+            + f", {len(self.baselined)} baselined,"
+            f" {len(self.pragma_suppressed)} pragma-suppressed")
+        for entry in self.stale_baseline:
+            lines.append(
+                "znicz-lint: stale baseline entry (matched nothing): "
+                f"{entry.get('rule')}: {entry.get('path')}: "
+                f"{entry.get('message')}")
+        return "\n".join(lines)
+
+
+def load_baseline(path: Optional[pathlib.Path]) -> List[dict]:
+    if path is None or not pathlib.Path(path).exists():
+        return []
+    data = json.loads(pathlib.Path(path).read_text())
+    entries = data["entries"] if isinstance(data, dict) else data
+    for e in entries:
+        for field in ("rule", "path", "message", "reason"):
+            if field not in e:
+                raise ValueError(
+                    f"baseline entry missing '{field}': {e}")
+    return entries
+
+
+def collect_modules(pkg_dir: pathlib.Path,
+                    paths: Optional[Sequence[pathlib.Path]] = None,
+                    ) -> Tuple[List[Module], List[Finding]]:
+    """Parse every target ``*.py`` once.  Unparseable files become
+    ``parse-error`` findings (never baselined away silently)."""
+    pkg_dir = pathlib.Path(pkg_dir).resolve()
+    files: List[pathlib.Path] = []
+    for p in (paths if paths else [pkg_dir]):
+        p = pathlib.Path(p).resolve()
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    modules, errors = [], []
+    for path in files:
+        try:
+            rel = path.relative_to(pkg_dir).as_posix()
+        except ValueError:
+            rel = path.name
+        text = path.read_text()
+        try:
+            modules.append(Module(path, rel, text))
+        except SyntaxError as exc:
+            errors.append(Finding(
+                "parse-error", rel, exc.lineno or 0,
+                f"cannot parse: {exc.msg}"))
+    return modules, errors
+
+
+def default_checkers(pkg_dir: pathlib.Path) -> List[Checker]:
+    from .config_knob import ConfigKnobChecker
+    from .counters import CounterRegistryChecker
+    from .jit_purity import JitPurityChecker
+    from .threads import ThreadSharedStateChecker
+
+    return [ThreadSharedStateChecker(), JitPurityChecker(),
+            ConfigKnobChecker(pkg_dir), CounterRegistryChecker()]
+
+
+def run(pkg_dir: pathlib.Path,
+        rules: Optional[Sequence[str]] = None,
+        baseline_path: Optional[pathlib.Path] = DEFAULT_BASELINE,
+        paths: Optional[Sequence[pathlib.Path]] = None,
+        checkers: Optional[Sequence[Checker]] = None) -> Analysis:
+    """One full analysis pass: parse once, run every (selected)
+    checker, then split raw findings into live / pragma-suppressed /
+    baselined."""
+    pkg_dir = pathlib.Path(pkg_dir).resolve()
+    modules, parse_errors = collect_modules(pkg_dir, paths)
+    active = list(checkers) if checkers is not None \
+        else default_checkers(pkg_dir)
+    if rules:
+        wanted = set(rules)
+        unknown = wanted - {c.name for c in active}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        active = [c for c in active if c.name in wanted]
+
+    raw: List[Finding] = []
+    for module in modules:
+        for checker in active:
+            raw.extend(checker.check(module))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    entries = load_baseline(baseline_path)
+    # each entry absorbs up to entry["count"] (default 1) findings with
+    # its (rule, path, message) key — the key is line-free, so the
+    # count is what keeps the gate tight: an N+1th identical finding in
+    # the same file is LIVE, not silently absorbed
+    budget: Dict[Tuple[str, str, str], List[List]] = {}
+    for e in entries:
+        key = (e["rule"], e["path"], e["message"])
+        budget.setdefault(key, []).append(
+            [int(e.get("count", 1)), e["reason"], e])
+    live, baselined, pragma = [], [], []
+    for f in raw:
+        module = next((m for m in modules if m.rel == f.path), None)
+        if module is not None and module.suppressed(f.rule, f.line):
+            pragma.append(f)
+            continue
+        slot = next((s for s in budget.get(f.key, []) if s[0] > 0), None)
+        if slot is not None:
+            slot[0] -= 1
+            baselined.append((f, slot[1]))
+        else:
+            live.append(f)
+    # an entry is STALE only if this scan could have matched it: its
+    # rule ran and its file was scanned (a --rules or path-subset run
+    # must not cry stale over out-of-scope entries)
+    scanned = {m.rel for m in modules}
+    ran = {c.name for c in active}
+    stale = [slot[2] for slots in budget.values() for slot in slots
+             if slot[0] == int(slot[2].get("count", 1))
+             and slot[2]["rule"] in ran and slot[2]["path"] in scanned]
+    return Analysis(live, baselined, pragma, stale, parse_errors)

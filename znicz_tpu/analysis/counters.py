@@ -1,0 +1,112 @@
+"""counter-registry: no NEW ad-hoc ``self.<counter> += 1`` accounting.
+
+PRs 1-4 each grew bespoke ``self.<name> += 1`` counters (``bad_frames``,
+``prefetch_hits``, ``shed``, ...), readable only through whichever panel
+their owner happened to wire up; ISSUE 5 moved them all into the
+telemetry registry (znicz_tpu/telemetry/) where every counter exports
+uniformly on ``/metrics``.  The original guard was a line-anchored regex
+(tests/test_no_adhoc_counters.py) — this is its AST-accurate port: a
+counter increment is flagged wherever the statement sits (after a ``;``,
+inside a one-line ``if``, multi-target), and the ``self.x = self.x + 1``
+spelling the regex could never see is caught too.
+
+Flagged: ``self.<name> += <expr>`` and ``self.<name> = self.<name> +
+<expr>`` (either operand order) where ``<name>`` ends in a counter
+suffix — the union of every counter name the registry migration
+absorbed, so the regression class is exactly "a counter like the ones
+we already centralized".
+
+Exempt: ``znicz_tpu/telemetry/`` (the registry implements itself), and
+the :data:`ALLOWLIST` below — attributes that LOOK counter-ish but are
+training/streaming STATE, not metrics, each with its reason.  New
+non-metric state joins the allowlist with a justification; new metrics
+go through ``telemetry.scope(...).counter(...).inc()``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, Module
+
+RULE = "counter-registry"
+
+#: attribute-name suffixes that mean "this is a counter"
+SUFFIXES = ("count", "total", "hits", "frames", "saves", "done",
+            "requeued", "reconnects", "replies", "registrations",
+            "updates", "rejected", "shed", "oversized", "compiles",
+            "received", "served", "batches", "errors", "resends")
+
+#: (path-relative-to-znicz_tpu, attribute) pairs that look counter-ish
+#: but are STATE, not metrics — each with its reason (moved verbatim
+#: from the original regex lint's ALLOWLIST; tests/
+#: test_no_adhoc_counters.py asserts this table stays the single
+#: source of truth)
+ALLOWLIST = {
+    # PRNG/step-key stream position: training semantics (jax_key(step)),
+    # not accounting; mirrored into the registry as trainer/train_steps
+    ("parallel/fused.py", "steps_done"),
+    # loader cursor over the resident set (drives epoch bookkeeping)
+    ("loader/base.py", "samples_served"),
+    # graphics PUB/SUB frame cursor on the plotting side-channel
+    ("graphics.py", "received"),
+    # kohonen epoch accumulators (averaged into qerror / the winners
+    # histogram, then reset)
+    ("kohonen.py", "_batches"),
+    ("kohonen.py", "total"),
+}
+
+
+def _counter_name(node: ast.expr) -> str | None:
+    """``self.<attr>`` with a counter suffix -> attr, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr.endswith(SUFFIXES)):
+        return node.attr
+    return None
+
+
+class CounterRegistryChecker(Checker):
+    name = RULE
+
+    def __init__(self, allowlist=ALLOWLIST, exempt_dirs=("telemetry/",)):
+        self.allowlist = set(allowlist)
+        self.exempt_dirs = tuple(exempt_dirs)
+
+    def check(self, module: Module):
+        if module.rel.startswith(self.exempt_dirs):
+            return []
+        findings: List[Finding] = []
+
+        def flag(attr: str, line: int) -> None:
+            if (module.rel, attr) in self.allowlist:
+                return
+            findings.append(Finding(
+                RULE, module.rel, line,
+                f"ad-hoc counter increment 'self.{attr}' — register it "
+                f"in znicz_tpu/telemetry instead "
+                f"(telemetry.scope(...).counter(...).inc()), or "
+                f"allowlist non-metric state with a justification"))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.op, ast.Add):
+                attr = _counter_name(node.target)
+                if attr is not None:
+                    flag(attr, node.lineno)
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.BinOp) and isinstance(
+                    node.value.op, ast.Add):
+                for target in node.targets:
+                    attr = _counter_name(target)
+                    if attr is None:
+                        continue
+                    for operand in (node.value.left, node.value.right):
+                        if (isinstance(operand, ast.Attribute)
+                                and ast.unparse(operand)
+                                == ast.unparse(target)):
+                            flag(attr, node.lineno)
+                            break
+        return findings

@@ -1,0 +1,278 @@
+"""thread-shared-state: unlocked attributes shared with a worker thread.
+
+The PR 6 / PR 7 review-hardening bug class, automated: ``admission_stats``
+snapshots, the compute-fault cursor, and the ``DecodePool`` futures dict
+were each mutated on a worker thread and read elsewhere with no lock —
+found by a human on the third pass every time.  This checker finds them
+mechanically:
+
+  1. **thread entry points** per class: any method handed to
+     ``threading.Thread(target=self.m)`` / ``threading.Timer(t, self.m)``
+     anywhere in the class body, plus methods submitted to an executor
+     (``<pool>.submit(self.m, ...)``);
+  2. the **worker-reachable set**: the entry methods plus everything
+     they call through ``self.m()`` (transitive);
+  3. per-attribute **mutation sites** (``self.a = ...``, ``self.a += 1``,
+     ``self.a[k] = v``, ``del self.a[k]``, and container-mutator calls
+     like ``self.a.append/pop/update``) and **access sites**, each tagged
+     with whether an enclosing ``with self.<lock>`` (or a name that looks
+     like a lock/cond/gate/mutex) guards it;
+  4. a finding for every attribute that is mutated UNLOCKED on a
+     worker-reachable method and also touched by a non-worker method —
+     ``__init__`` is exempt on both sides (it runs before any thread
+     starts).
+
+Heuristics, stated plainly: ``queue.Queue`` traffic (``put``/``get``)
+is not a mutation (those objects lock internally); a ``with`` on any
+``self.<attr>`` counts as a guard (in this codebase every such context
+manager is a Lock/RLock/Condition); attributes only the workers touch
+are not findings (no sharing, no race).  Accepted leftovers are
+baselined with a justification, not silenced.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import Checker, Finding, Module
+
+RULE = "thread-shared-state"
+
+#: container-mutation method names that count as writing the attribute
+MUTATORS = {"append", "appendleft", "extend", "add", "insert", "pop",
+            "popleft", "popitem", "update", "clear", "remove", "discard",
+            "setdefault", "__setitem__"}
+
+#: names that read as a synchronization primitive when used in ``with``
+LOCKISH = ("lock", "cond", "gate", "mutex", "sem")
+
+#: constructor names whose instances synchronize internally — an
+#: attribute initialized from one of these is exempt from the rule
+#: (``self._stop.set()`` on an Event, ``self._q.put()`` on a Queue):
+#: their "mutations" are the thread-safe API, not shared raw state
+SYNC_TYPES = {"Event", "Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue",
+              "LifoQueue", "PriorityQueue"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lockish_ctx(expr: ast.expr) -> bool:
+    """Does this ``with`` context expression look like a lock?"""
+    if _self_attr(expr) is not None:
+        return True                       # with self._anything: = a guard
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Call):
+        return _is_lockish_ctx(expr.func)
+    return name is not None and any(t in name.lower() for t in LOCKISH)
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect one method's self-attribute writes/reads (with lock
+    context), ``self.m()`` calls, and thread-target registrations."""
+
+    def __init__(self) -> None:
+        self.writes: List[Tuple[str, int, bool]] = []   # (attr, line, locked)
+        self.reads: List[Tuple[str, int, bool]] = []
+        self.calls: Set[str] = set()
+        self.spawn_targets: Set[str] = set()
+        self.sync_attrs: Set[str] = set()   # self.x = threading.Event()
+        self._lock_depth = 0
+
+    # -- lock context --------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        guarded = any(_is_lockish_ctx(item.context_expr)
+                      for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        if guarded:
+            self._lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guarded:
+            self._lock_depth -= 1
+
+    # -- writes --------------------------------------------------------------
+
+    def _record_write(self, attr: str, line: int) -> None:
+        self.writes.append((attr, line, self._lock_depth > 0))
+
+    def _scan_target(self, target: ast.expr) -> None:
+        attr = _self_attr(target)
+        if attr is not None:
+            self._record_write(attr, target.lineno)
+            return
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            # self.a[k] = v / self.a.b = v mutate container/object a
+            inner = _self_attr(target.value)
+            if inner is not None:
+                self._record_write(inner, target.lineno)
+            else:
+                self.visit(target.value)
+            if isinstance(target, ast.Subscript):
+                self.visit(target.slice)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._scan_target(elt)
+        else:
+            self.visit(target)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            ctor = node.value.func
+            ctor_name = ctor.attr if isinstance(ctor, ast.Attribute) \
+                else (ctor.id if isinstance(ctor, ast.Name) else None)
+            if ctor_name in SYNC_TYPES:
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        self.sync_attrs.add(attr)
+        for target in node.targets:
+            self._scan_target(target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._scan_target(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._scan_target(node.target)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                inner = _self_attr(target.value)
+                if inner is not None:
+                    self._record_write(inner, target.lineno)
+            self.generic_visit(target)
+
+    # -- reads, calls, spawns ------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self.reads.append((attr, node.lineno, self._lock_depth > 0))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv = _self_attr(func.value)
+            if recv is not None and func.attr in MUTATORS:
+                self._record_write(recv, node.lineno)
+            method = _self_attr(func)
+            if method is not None:
+                self.calls.add(method)
+            # thread / timer / executor handing out self.<m>
+            if func.attr in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = _self_attr(kw.value)
+                        if tgt is not None:
+                            self.spawn_targets.add(tgt)
+                for arg in node.args:
+                    tgt = _self_attr(arg)
+                    if tgt is not None:
+                        self.spawn_targets.add(tgt)
+            elif func.attr == "submit":
+                if node.args:
+                    tgt = _self_attr(node.args[0])
+                    if tgt is not None:
+                        self.spawn_targets.add(tgt)
+        elif isinstance(func, ast.Name) and func.id in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tgt = _self_attr(kw.value)
+                    if tgt is not None:
+                        self.spawn_targets.add(tgt)
+        self.generic_visit(node)
+
+    # nested defs/lambdas inside a method run on the same thread as the
+    # method that CALLS them, which we approximate as the enclosing
+    # method's thread — keep scanning (worker loops build closures)
+
+
+class ThreadSharedStateChecker(Checker):
+    name = RULE
+
+    def check(self, module: Module):
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    def _check_class(self, module: Module, cls: ast.ClassDef):
+        methods: Dict[str, ast.FunctionDef] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[stmt.name] = stmt
+        scans: Dict[str, _MethodScan] = {}
+        entries: Set[str] = set()
+        sync_attrs: Set[str] = set()
+        for name, fn in methods.items():
+            scan = _MethodScan()
+            for stmt in fn.body:
+                scan.visit(stmt)
+            scans[name] = scan
+            entries |= scan.spawn_targets & set(methods)
+            sync_attrs |= scan.sync_attrs
+        if not entries:
+            return []
+
+        # worker-reachable closure over self.m() edges
+        worker: Set[str] = set()
+        frontier = list(entries)
+        while frontier:
+            m = frontier.pop()
+            if m in worker or m not in methods:
+                continue
+            worker.add(m)
+            frontier.extend(scans[m].calls & set(methods))
+
+        findings: List[Finding] = []
+        for wname in sorted(worker):
+            if wname == "__init__":
+                continue
+            # one finding PER UNLOCKED WRITE SITE (not per attribute):
+            # identical sites share a line-free (rule, path, message)
+            # key, so the baseline's count cap stays meaningful — a NEW
+            # unlocked mutation of an already-baselined attribute is
+            # the N+1th identical finding and comes up LIVE
+            for attr, line, locked in scans[wname].writes:
+                if locked or attr in sync_attrs:
+                    continue
+                others = sorted(
+                    oname for oname in methods
+                    if oname not in worker and oname != "__init__"
+                    and any(a == attr for a, _, _ in
+                            scans[oname].writes + scans[oname].reads))
+                if not others:
+                    continue
+                entry = sorted(entries)[0]
+                findings.append(Finding(
+                    RULE, module.rel, line,
+                    f"{cls.name}.{attr} is mutated in {wname}() on the "
+                    f"worker thread (entry {entry}()) without an "
+                    f"enclosing lock, but is also accessed from "
+                    f"{others[0]}() — guard both sides with the same "
+                    f"'with self.<lock>' or baseline with a "
+                    f"justification"))
+        return findings

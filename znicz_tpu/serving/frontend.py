@@ -73,23 +73,21 @@ def _cfg(name: str, override):
 
 
 def _admission_from_config() -> AdmissionPolicy:
-    # every read spells the LITERAL root.common.serving.admission chain:
-    # the config-knob lint (tests/test_no_adhoc_counters.py) matches
-    # these chains textually, and binding the subtree to a variable
-    # would hide the key reads from it
+    # the admission subtree is bound to a local alias: znicz-lint's
+    # config-knob checker (znicz_tpu/analysis/config_knob.py) resolves
+    # every .get() read THROUGH the alias against the DEFAULTS table,
+    # so the old "spell the literal chain at each read site" workaround
+    # (the regex lint was blind to aliasing) is retired
     d = DEFAULTS["admission"]
+    adm = root.common.serving.admission
     return AdmissionPolicy(
-        rate_limit=float(root.common.serving.admission.get(
-            "rate_limit", d["rate_limit"])),
-        rate_burst=float(root.common.serving.admission.get(
-            "rate_burst", d["rate_burst"])),
-        fair=bool(root.common.serving.admission.get("fair", d["fair"])),
-        quantum=int(root.common.serving.admission.get(
-            "quantum", d["quantum"])),
-        client_queue_bound=int(root.common.serving.admission.get(
-            "client_queue_bound", d["client_queue_bound"])),
-        enabled=bool(root.common.serving.admission.get(
-            "enabled", d["enabled"])))
+        rate_limit=float(adm.get("rate_limit", d["rate_limit"])),
+        rate_burst=float(adm.get("rate_burst", d["rate_burst"])),
+        fair=bool(adm.get("fair", d["fair"])),
+        quantum=int(adm.get("quantum", d["quantum"])),
+        client_queue_bound=int(adm.get("client_queue_bound",
+                                       d["client_queue_bound"])),
+        enabled=bool(adm.get("enabled", d["enabled"])))
 
 
 class InferenceServer:

@@ -438,11 +438,17 @@ class Snapshotter(Unit):
                 for tag in tags:
                     path = self.snapshot_path(tag)
                     self._write_host_format(path, snap)
-                    self.destination = path
+                    # the training thread writes destination too (sync
+                    # saves) and save() reads _async_error under this
+                    # lock — publish both under it (znicz-lint
+                    # thread-shared-state)
+                    with self._async_lock:
+                        self.destination = path
                     self._m["async_saves_written"].inc()
                     self.info("snapshot (async) -> %s", path)
             except BaseException as exc:   # surfaced on flush/next save
-                self._async_error = exc
+                with self._async_lock:
+                    self._async_error = exc
             finally:
                 with self._async_lock:
                     self._async_busy = False
