@@ -848,6 +848,271 @@ def wire_main() -> None:
             "fell below the 3.5x floor vs the v2 pickle wire")
 
 
+#: --agg protocol knobs (ISSUE 10): the O(slaves) -> O(fanout) proof.
+#: Phase 1 (structural, scripted): 8 protocol-exact scripted slaves run
+#: the same seeded job/update stream once as a STAR (all 8 on the
+#: master) and once through a fanout-2 RELAY TREE (8 -> 4 -> 2 ->
+#: master); the master's wire.Codec counts bytes-into-master and
+#: messages decoded.  Both must drop to <= 0.35x the star's — the ~4x
+#: the two aggregated tiers owe.  Phase 2 (semantic, seeded MNIST): a
+#: real 4-slave training once as a star and once through a 2-level
+#: tree (2 leaf relays under 1 mid relay) must land in the same
+#: converged band — error-feedback residuals held at the leaves AND
+#: per-relay, so quantization behavior is unchanged.  Gates fire AFTER
+#: the JSON line so a trip never destroys the measurement.
+AGG_SLAVES = 8
+AGG_FANOUT = 2
+AGG_RATIO_CEIL = 0.35
+AGG_CONV_BAND = 25.0        # |star - tree| err_pct tolerance (async
+#                             replicas differ run to run regardless of
+#                             topology; both must land converged)
+AGG_ERR_CEIL = 70.0
+AGG_BASE_PORT = 18600
+
+
+def _agg_make_workflow(tag: str, max_epochs: int = 3,
+                       n_train: int = 300):
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = n_train
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = max_epochs
+    root.common.dirs.snapshots = f"/tmp/bench_agg/{tag}"
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def _agg_scripted_slave(endpoint: str, sid: str, register_msg: dict,
+                        shapes: dict, errors: list) -> None:
+    """A protocol-exact scripted slave: registers, pulls jobs, replies
+    tiny constant deltas of the right shapes — all the wire traffic of
+    a real slave with none of the compute, so the byte/decode counters
+    measure TOPOLOGY, not this host's training speed."""
+    import zmq
+
+    from znicz_tpu.parallel import wire
+
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.REQ)
+    sock.setsockopt(zmq.RCVTIMEO, 60_000)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.connect(endpoint)
+
+    def rpc(msg):
+        frames, _ = wire.encode_message(dict(msg, id=sid))
+        sock.send_multipart(frames)
+        return wire.decode_message(sock.recv_multipart())[0]
+
+    try:
+        rep = rpc(register_msg)
+        if not rep.get("ok"):
+            raise RuntimeError(f"register refused: {rep.get('error')}")
+        while True:
+            rep = rpc({"cmd": "job"})
+            if rep.get("done"):
+                return
+            if "job" not in rep:
+                time.sleep(0.005)           # wait / transient
+                continue
+            job = rep["job"]
+            deltas = None
+            if rep.get("train"):
+                deltas = {name: {k: np.full(shape, 1e-6, np.float32)
+                                 for k, shape in layer.items()}
+                          for name, layer in shapes.items()}
+            if "minibatches" in job:
+                metrics = [{"loss": 1.0, "n_err": 0}
+                           for _ in job["minibatches"]]
+            else:
+                metrics = {"loss": 1.0, "n_err": 0}
+            rpc({"cmd": "update", "job_id": rep["job_id"],
+                 "deltas": deltas, "metrics": metrics})
+    except Exception as exc:                # surface thread crashes
+        errors.append((sid, repr(exc)))
+        raise
+    finally:
+        sock.close(0)
+
+
+def _agg_scripted_run(endpoints, master_endpoint, tag):
+    """Drive AGG_SLAVES scripted slaves against ``endpoints[i]`` (the
+    star: all the master; the tree: their leaf relays); returns the
+    master server after completion."""
+    import threading
+
+    from znicz_tpu.network_common import handshake_request
+    from znicz_tpu.server import Server
+
+    # plentiful jobs (30 TRAIN minibatches/epoch for 8 slaves) so the
+    # stream stays dense enough for pairs to FORM at every tier — the
+    # regime the tree exists for; a trickle would measure idle polling
+    wf = _agg_make_workflow(f"{tag}_m", max_epochs=2, n_train=1800)
+    server = Server(wf, endpoint=master_endpoint, job_timeout=60.0)
+    register = handshake_request(wf)
+    shapes = {f.name: {k: tuple(a.shape) for k, a in f.params().items()}
+              for f in wf.forwards if f.has_weights}
+    errors: list = []
+    threads = [threading.Thread(
+        target=_agg_scripted_slave,
+        args=(endpoints[i], f"{tag}{i}", register, shapes, errors),
+        daemon=True) for i in range(AGG_SLAVES)]
+    for t in threads:
+        t.start()
+    server.serve()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise SystemExit(f"scripted slaves crashed: {errors}")
+    if any(t.is_alive() for t in threads):
+        raise SystemExit("scripted slaves hung")
+    if not bool(wf.decision.complete):
+        raise SystemExit("scripted run did not complete")
+    return server
+
+
+def _agg_real_fleet(endpoints, master_endpoint, tag):
+    """A real seeded 4-slave MNIST training over whatever topology sits
+    between ``endpoints`` and the master; returns (server, err_pct)."""
+    import threading
+
+    from znicz_tpu.client import Client
+    from znicz_tpu.server import Server
+
+    wf = _agg_make_workflow(f"{tag}_m")
+    server = Server(wf, endpoint=master_endpoint, job_timeout=60.0)
+    slaves = [Client(_agg_make_workflow(f"{tag}_s{i}"),
+                     endpoint=endpoints[i], slave_id=f"{tag}w{i}")
+              for i in range(len(endpoints))]
+    errors: list = []
+
+    def worker(s):
+        try:
+            s.run()
+        except BaseException as e:
+            errors.append((s.slave_id, repr(e)))
+            raise
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in slaves]
+    for t in threads:
+        t.start()
+    server.serve()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise SystemExit(f"slaves crashed: {errors}")
+    dec = wf.decision
+    if not bool(dec.complete):
+        raise SystemExit(f"{tag}: training did not complete")
+    return server, float(dec.epoch_metrics[1]["err_pct"])
+
+
+def agg_main() -> None:
+    """``--agg``: the relay-tree aggregation gate (ISSUE 10).  One JSON
+    line with the star-vs-tree byte/decode ratios and the convergence
+    band; FAILS (after printing) when bytes-into-master or the master's
+    decode count at fanout 2 with 8 scripted slaves exceeds 0.35x the
+    star's, or when the tree's seeded MNIST run leaves the star's
+    convergence band."""
+    from znicz_tpu.parallel.relay import Relay, plan_tree
+
+    port = AGG_BASE_PORT
+
+    # -- phase 1: scripted star ------------------------------------------------
+    star_master = f"tcp://127.0.0.1:{port}"
+    star = _agg_scripted_run([star_master] * AGG_SLAVES, star_master,
+                             "star")
+    star_bytes = int(star.bytes_in)
+    star_decodes = int(star.codec.messages_in)
+
+    # -- phase 1: scripted fanout-2 tree (8 -> 4 -> 2 -> master) ---------------
+    tree_master = f"tcp://127.0.0.1:{port + 1}"
+    plan = plan_tree(AGG_SLAVES, AGG_FANOUT, tree_master,
+                     base_port=port + 2)
+    relays = [Relay(r["upstream"], r["bind"], relay_id=f"agg-r{i}",
+                    fanout=AGG_FANOUT).start()
+              for i, r in enumerate(plan["relays"])]
+    try:
+        tree = _agg_scripted_run(plan["slave_endpoints"], tree_master,
+                                 "tree")
+    finally:
+        for r in relays:
+            r.stop()
+    tree_bytes = int(tree.bytes_in)
+    tree_decodes = int(tree.codec.messages_in)
+    bytes_ratio = tree_bytes / max(1, star_bytes)
+    decode_ratio = tree_decodes / max(1, star_decodes)
+
+    # -- phase 2: seeded MNIST convergence, star vs 2-level tree ---------------
+    conv_star_master = f"tcp://127.0.0.1:{port + 20}"
+    srv_star, err_star = _agg_real_fleet(
+        [conv_star_master] * 4, conv_star_master, "cstar")
+    conv_tree_master = f"tcp://127.0.0.1:{port + 21}"
+    mid = f"tcp://127.0.0.1:{port + 22}"
+    leaf_a = f"tcp://127.0.0.1:{port + 23}"
+    leaf_b = f"tcp://127.0.0.1:{port + 24}"
+    relays = [Relay(conv_tree_master, mid, relay_id="agg-mid").start(),
+              Relay(mid, leaf_a, relay_id="agg-leaf-a").start(),
+              Relay(mid, leaf_b, relay_id="agg-leaf-b").start()]
+    try:
+        srv_tree, err_tree = _agg_real_fleet(
+            [leaf_a, leaf_a, leaf_b, leaf_b], conv_tree_master, "ctree")
+    finally:
+        for r in relays:
+            r.stop()
+
+    print(json.dumps({
+        "metric": "agg_bytes_into_master_ratio",
+        "value": round(bytes_ratio, 4),
+        "unit": "tree/star",
+        "vs_baseline": round(1.0 / max(bytes_ratio, 1e-9), 2),
+        "slaves": AGG_SLAVES, "fanout": AGG_FANOUT,
+        "star": {"bytes_in": star_bytes, "decodes": star_decodes,
+                 "jobs_done": star.jobs_done,
+                 "updates": star.updates_received},
+        "tree": {"bytes_in": tree_bytes, "decodes": tree_decodes,
+                 "jobs_done": tree.jobs_done,
+                 "updates": tree.updates_received,
+                 "aggregated": tree.aggregated_updates,
+                 "levels": plan["levels"]},
+        "decode_ratio": round(decode_ratio, 4),
+        "convergence": {"star_err_pct": err_star,
+                        "tree_err_pct": err_tree,
+                        "tree_aggregated":
+                            srv_tree.aggregated_updates,
+                        "star_aggregated":
+                            srv_star.aggregated_updates},
+    }))
+    # gates AFTER the JSON line (ISSUE 10 acceptance)
+    if bytes_ratio > AGG_RATIO_CEIL:
+        raise SystemExit(
+            f"bytes-into-master ratio {bytes_ratio:.3f} exceeds the "
+            f"{AGG_RATIO_CEIL} ceiling (star {star_bytes}, tree "
+            f"{tree_bytes})")
+    if decode_ratio > AGG_RATIO_CEIL:
+        raise SystemExit(
+            f"master decode-count ratio {decode_ratio:.3f} exceeds the "
+            f"{AGG_RATIO_CEIL} ceiling (star {star_decodes}, tree "
+            f"{tree_decodes})")
+    if err_star >= AGG_ERR_CEIL or err_tree >= AGG_ERR_CEIL:
+        raise SystemExit(
+            f"convergence left the band: star {err_star}%, tree "
+            f"{err_tree}% (ceiling {AGG_ERR_CEIL}%)")
+    if abs(err_star - err_tree) >= AGG_CONV_BAND:
+        raise SystemExit(
+            f"star-vs-tree convergence gap {abs(err_star - err_tree):.1f}"
+            f" exceeds the {AGG_CONV_BAND}-point band "
+            f"(star {err_star}%, tree {err_tree}%)")
+    if srv_tree.aggregated_updates <= 0 or tree.aggregated_updates <= 0:
+        raise SystemExit("tree runs produced no aggregated updates — "
+                         "the relays were not in the path")
+
+
 #: --serve protocol knobs (ISSUE 4).  All gates are RELATIVE to numbers
 #: measured on the same host in the same process, so they hold on this
 #: TPU-less throttled-CPU container and transfer unchanged to a TPU
@@ -1606,6 +1871,8 @@ if __name__ == "__main__":
         ingest_main()
     elif "--wire" in args:
         wire_main()
+    elif "--agg" in args:
+        agg_main()
     elif "--serve" in args:
         serve_main()
     elif "--stream" in args:

@@ -110,6 +110,7 @@ def test_corrupt_payload_is_undecodable():
 # -- frame chaos through the proxy ---------------------------------------------
 
 
+@pytest.mark.slow
 def test_chaos_proxy_faults_accounted(tmp_path):
     """The acceptance run: seeded drop/corrupt/duplicate/delay between
     two slaves and the master.  Training completes without hang or
@@ -117,7 +118,12 @@ def test_chaos_proxy_faults_accounted(tmp_path):
     fault is accounted for: corrupted requests == the master's
     bad_frames, corrupted replies == the slaves' bad_replies, and every
     starved receive (drops + corrupted replies) shows up as a client
-    reconnect."""
+    reconnect.
+
+    ``slow`` since ISSUE 10 (tier-1 budget): ~20s, and the coverage is
+    structural-duplicated by the lean multipart-corruption test plus
+    the relay chaos suite; the full accounting proof runs in the slow
+    lane with the soaks."""
     from znicz_tpu.client import Client
     from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
     from znicz_tpu.server import Server

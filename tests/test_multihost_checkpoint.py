@@ -13,6 +13,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -123,7 +124,12 @@ def _spawn_pair(phase, tmp_path):
     return results
 
 
+@pytest.mark.slow
 def test_two_process_checkpoint_kill_restore_finish(tmp_path):
+    # slow since ISSUE 10 (tier-1 budget): ~20s of subprocess spawns;
+    # the single-process restore path stays covered by the lean
+    # snapshotter tests, the full 2-process kill/restore proof runs in
+    # the slow lane.
     # phase 1: 2-process train; sharded orbax checkpoint lands at the end
     # of epoch 1; the processes then FINISH the 4 epochs, making their
     # own trajectory the uninterrupted oracle.  Both processes then exit

@@ -46,6 +46,25 @@ class _BadReply(Exception):
     re-register."""
 
 
+def scheduled_hypers_rows(base_hypers: Dict, mbs: List[dict]) -> Dict:
+    """Per-step hypers rows for a fused job under a master-evaluated LR
+    schedule (ISSUE 10 satellite): start from the slave's own constant
+    hypers (identical to the master's bases — the workflow digest
+    guarantees it) and overwrite (lr, lr_bias) — rows 0 and 1 of the
+    8-wide hypers tuple — with the scheduled values the master stamped
+    on each TRAIN minibatch at dispatch."""
+    rows = []
+    for mb in mbs:
+        row = {name: np.array(t, np.float32)
+               for name, t in base_hypers.items()}
+        for name, pair in (mb.get("hypers") or {}).items():
+            if name in row:
+                row[name][0] = np.float32(pair[0])
+                row[name][1] = np.float32(pair[1])
+        rows.append(row)
+    return {name: np.stack([r[name] for r in rows]) for name in rows[0]}
+
+
 class _JobPrefetcher:
     """Pipelined job fetch (ISSUE 3): while the trainer computes job N,
     this thread requests job N+1 on its OWN REQ socket (ZMQ sockets are
@@ -208,6 +227,12 @@ class Client:
         self._tracer = telemetry.tracer()
         self.wire_dtype = "float32"     # resolved from config in run()
         self._delta_encoder = None
+        #: the endpoint our relay advertised as ITS upstream (ISSUE 10):
+        #: when the reconnect budget to a dead relay is spent, the slave
+        #: falls back here and re-registers through the existing path —
+        #: relay death costs a backoff window, not the slave.  The
+        #: master advertises none, so the star behavior is unchanged.
+        self._fallback_endpoint: Optional[str] = None
 
     def _rpc(self, sock, msg: dict) -> dict:
         from znicz_tpu.parallel import wire
@@ -274,6 +299,17 @@ class Client:
             metrics["confusion"] = np.array(
                 wf.evaluator.confusion_matrix.map_read())
         if train:
+            # LR schedules under master/slave (ISSUE 10 satellite): the
+            # master evaluated its lr_adjust policies at dispatch and
+            # stamped the scheduled per-layer rates on the minibatch —
+            # apply them before the gds so the schedule advances
+            # exactly as in local training
+            sched = job.get("hypers") or {}
+            for gd in wf.gds:
+                pair = sched.get(gd.forward.name)
+                if pair:
+                    gd.learning_rate = float(pair[0])
+                    gd.learning_rate_bias = float(pair[1])
             wf.decision.gd_skip.set(False)
             for gd in wf.gds:
                 gd.run()
@@ -331,7 +367,6 @@ class Client:
         import zmq
 
         from znicz_tpu.core.config import root
-        from znicz_tpu.lr_adjust import LearningRateAdjust
         from znicz_tpu.network_common import handshake_request
         from znicz_tpu.parallel import wire
 
@@ -353,18 +388,10 @@ class Client:
         self._delta_encoder = wire.DeltaEncoder(self.wire_dtype)
         prefetch_on = bool(root.common.engine.get("job_prefetch", True))
         log = logging.getLogger("znicz")
-
-        if any(isinstance(u, LearningRateAdjust)
-               for u in self.workflow.units):
-            # slaves run forwards/evaluator/gds per job, never the
-            # lr_adjust unit — true for BOTH engines (the fused slave's
-            # constant tiled_hypers match the unit slave exactly), so an
-            # LR schedule silently freezes at its initial value in the
-            # async master/slave mode.  Say so instead of being subtle.
-            log.warning(
-                "%s: LR schedules do not advance in master/slave mode "
-                "(slaves run gds only); training proceeds at the "
-                "current learning rate", self.slave_id)
+        # (LR schedules DO advance in master/slave mode since ISSUE 10:
+        # the master evaluates lr_adjust policies at dispatch and ships
+        # the scheduled hypers inside each TRAIN minibatch — applied in
+        # _run_one / scheduled_hypers_rows for both engines.)
 
         rng = random.Random(f"{self.slave_id}/backoff")
         ctx = zmq.Context.instance()
@@ -412,10 +439,27 @@ class Client:
                         f"{recv_timeout:g}s — is the master running "
                         f"(launcher --master)?") from None
             elif failures > max_reconnects:
-                log.warning(
-                    "%s: giving up after %d consecutive reconnects "
-                    "(master gone for good?)", self.slave_id, failures - 1)
-                return False
+                fallback = self._fallback_endpoint
+                if fallback and fallback != self.endpoint:
+                    # our relay is gone for good: fall back to the
+                    # upstream it advertised at register time (ISSUE
+                    # 10) and ride the existing re-registration path.
+                    # One hop per spent budget — the next successful
+                    # register records the NEW peer's advertisement.
+                    log.warning(
+                        "%s: relay at %s gone after %d consecutive "
+                        "reconnects — falling back to its upstream %s",
+                        self.slave_id, self.endpoint, failures - 1,
+                        fallback)
+                    self.endpoint = fallback
+                    self._fallback_endpoint = None
+                    failures = 1
+                else:
+                    log.warning(
+                        "%s: giving up after %d consecutive reconnects "
+                        "(master gone for good?)", self.slave_id,
+                        failures - 1)
+                    return False
             sock.close(0)               # EFSM: unusable after a timeout
             self._m["reconnects"].inc()
             registered = False
@@ -445,6 +489,9 @@ class Client:
                         raise RuntimeError(
                             f"master refused registration: "
                             f"{rep.get('error')}")
+                    # a relay advertises its upstream for dead-relay
+                    # failover; the master advertises none
+                    self._fallback_endpoint = rep.get("upstream")
                     registered = ever_registered = True
                     continue
                 if update_frames is not None:
@@ -608,8 +655,16 @@ class FusedClient(Client):
         from znicz_tpu.core import prng
 
         steps = np.arange(t.steps_done, t.steps_done + k, dtype=np.int32)
+        # master-scheduled hypers (ISSUE 10 satellite): the FusedTrainer
+        # already takes per-step hypers rows as traced arguments (no
+        # recompile) — feed it the SCHEDULED values stamped on the job
+        # instead of constants when the master runs an LR schedule
+        if any("hypers" in mb for mb in mbs):
+            hyper_rows = scheduled_hypers_rows(t.hypers(), mbs)
+        else:
+            hyper_rows = t.tiled_hypers(k)
         params, self._velocities, ms, conf_sum = self._scan(
-            params, self._velocities, t.tiled_hypers(k), self._dataset,
+            params, self._velocities, hyper_rows, self._dataset,
             self._targets, idx, bs,
             prng.get("fused_trainer").jax_base_key(), steps)
         t.steps_done += k

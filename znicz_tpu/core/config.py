@@ -227,4 +227,7 @@ ENGINE_DEFAULTS = {
     "master_snapshot_s": 10.0,
     "wire_dtype": "float32",      # "float32" | "bfloat16" | "int8"
     "wire_compress": "none",      # "none" | "zlib" | "lz4"
+    # relay-tree aggregation (ISSUE 10)
+    "tree_fanout": 2,             # children per relay; job-batch factor
+    "relay_flush_s": 0.05,        # max buffered-contribution age
 }

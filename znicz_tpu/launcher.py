@@ -91,6 +91,27 @@ class Launcher:
         parser.add_argument("--slave", default=None, metavar="ENDPOINT",
                             help="work for the master at ENDPOINT "
                                  "(e.g. tcp://host:5570)")
+        parser.add_argument("--relay", default=None,
+                            metavar="UPSTREAM[:BIND]",
+                            help="run an aggregation-tree relay node "
+                                 "(ISSUE 10): accept slaves/relays at "
+                                 "BIND (default tcp://*:5571; a bare "
+                                 "port means tcp://*:PORT), validate + "
+                                 "sum-reduce their deltas and forward "
+                                 "ONE combined update to UPSTREAM.  "
+                                 "Needs no workflow argument")
+        parser.add_argument("--tree-fanout", type=int, default=None,
+                            metavar="N",
+                            help="children per relay "
+                                 "(root.common.engine.tree_fanout, "
+                                 "default 2): the flush threshold and "
+                                 "job-batch amplification factor")
+        parser.add_argument("--plan-tree", type=int, default=None,
+                            metavar="N_SLAVES",
+                            help="print the relay-tree plan (tiers, "
+                                 "endpoints, per-slave assignments) "
+                                 "for N_SLAVES at --tree-fanout and "
+                                 "exit")
         parser.add_argument("--serve", nargs="?", const="tcp://*:5580",
                             default=None, metavar="BIND",
                             help="serve this workflow's forward as a "
@@ -117,6 +138,17 @@ class Launcher:
     def run(self) -> int:
         setup_logging()
         args = self.args
+        if args.tree_fanout is not None:
+            root.common.engine.tree_fanout = int(args.tree_fanout)
+        if args.plan_tree is not None:
+            return self._plan_tree(args)
+        if args.relay is not None:
+            if args.master is not None or args.slave is not None \
+                    or args.serve is not None or args.master_resume:
+                print("error: --relay is mutually exclusive with the "
+                      "master/slave/serve roles", file=sys.stderr)
+                return 2
+            return self._relay(args)
         if args.list or not args.workflow:
             print("bundled samples:", ", ".join(SAMPLES))
             return 0
@@ -237,6 +269,55 @@ class Launcher:
                       file=sys.stderr)
                 return 3
             print(json.dumps({"genetics_fitness": float(fit)}), flush=True)
+        return 0
+
+    def _plan_tree(self, args) -> int:
+        """``--plan-tree N``: print the relay tiers a fleet of N slaves
+        needs at the configured fanout, as one JSON document — concrete
+        ``--relay`` specs (top tier first, so starting them in order
+        brings the tree up parents-before-children) plus the endpoint
+        each slave should dial."""
+        import json
+
+        from znicz_tpu.parallel.relay import plan_tree
+
+        master = (args.master
+                  or str(root.common.engine.get("master_bind",
+                                                "tcp://*:5570")))
+        master = master.replace("*", "127.0.0.1")
+        try:
+            plan = plan_tree(
+                int(args.plan_tree),
+                int(root.common.engine.get("tree_fanout", 2)), master)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        plan["master"] = master
+        plan["relay_args"] = [f"{r['upstream']}:{r['bind']}"
+                              for r in plan["relays"]]
+        print(json.dumps(plan, indent=2))
+        return 0
+
+    def _relay(self, args) -> int:
+        """``--relay UPSTREAM[:BIND]``: run one relay node until its
+        upstream reports training done (or Ctrl-C).  No workflow is
+        built — the relay validates children by passing the first
+        handshake upstream."""
+        from znicz_tpu.parallel.relay import Relay, parse_relay_spec
+
+        try:
+            upstream, bind = parse_relay_spec(args.relay)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        relay = Relay(upstream, bind)
+        print(f"relay {relay.relay_id}: children at {bind} -> "
+              f"upstream {upstream} (fanout {relay.fanout}, "
+              f"wire {relay.wire_dtype})", flush=True)
+        try:
+            relay.serve()
+        except KeyboardInterrupt:
+            pass
         return 0
 
     def _serve(self, mod, spec: str, args) -> int:

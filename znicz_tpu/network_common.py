@@ -99,6 +99,26 @@ def workflow_digest(workflow) -> str:
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def bind_with_retry(sock, endpoint: str, attempts: int = 40,
+                    delay_s: float = 0.05) -> None:
+    """Bind a ZMQ socket, retrying ONLY the EADDRINUSE race a restarted
+    peer has with its dying predecessor's port release — any other bind
+    error (bad host, EACCES) is permanent and surfaces immediately.
+    One home for the policy (master's REP loop and relay nodes)."""
+    import time
+
+    import zmq
+
+    for attempt in range(attempts):
+        try:
+            sock.bind(endpoint)
+            return
+        except zmq.error.ZMQError as exc:
+            if exc.errno != zmq.EADDRINUSE or attempt == attempts - 1:
+                raise
+            time.sleep(delay_s)
+
+
 def is_loopback_host(host: str) -> bool:
     """Shared trust guard for pickled-payload services (graphics client,
     remote forge): one home so loopback policy cannot drift per-module."""

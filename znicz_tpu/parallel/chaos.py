@@ -451,6 +451,44 @@ def _flood_main(argv: List[str]) -> None:  # pragma: no cover - subprocess
 # -- process-level kill harness ------------------------------------------------
 
 
+class RelayHarness:
+    """Kill/restart driver for an aggregation-tree relay (ISSUE 10).
+
+    ``start()`` builds a fresh :class:`relay.Relay` and serves it on a
+    daemon thread; ``kill()`` stops it mid-run — jobs in its queue and
+    contributions in its flush buffer are deliberately lost, exactly
+    what a crashed relay process loses; the master's TTL reaper
+    recovers the jobs (``jobs_requeued``) and the children either ride
+    out a ``restart()`` at the same bind via their existing
+    reconnect/re-register machinery, or fall back to the relay's
+    advertised upstream once their budget is spent.
+    """
+
+    def __init__(self, upstream: str, bind: str, **relay_kwargs):
+        self.upstream = upstream
+        self.bind = bind
+        self.relay_kwargs = relay_kwargs
+        self.relay = None
+        self.kills = 0
+
+    def start(self):
+        from znicz_tpu.parallel.relay import Relay
+
+        self.relay = Relay(self.upstream, self.bind, **self.relay_kwargs)
+        return self.relay.start()
+
+    def kill(self, timeout: float = 30.0) -> None:
+        """Simulated relay crash: buffered state dies with it."""
+        self.relay.stop(timeout)
+        self.kills += 1
+
+    def restart(self):
+        """A fresh relay at the SAME bind (children reconnect into it
+        and re-register through the existing path)."""
+        self.kill()
+        return self.start()
+
+
 def take_job_and_die(endpoint: str, workflow, slave_id: str = "doomed",
                      timeout_ms: int = 10_000) -> Optional[int]:
     """The canonical mid-job slave death: register, take ONE job, vanish

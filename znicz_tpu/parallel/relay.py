@@ -1,0 +1,847 @@
+"""Relay-tree gradient aggregation (ISSUE 10): O(log N) instead of a
+star.
+
+The async master/slave stack (server.py / client.py) is a fixed star:
+the master decodes EVERY slave's update, so aggregation cost is
+O(slaves) in both CPU and ingress bytes — fine at 5 slaves, a wall at
+pod scale (ROADMAP item 3).  Wire v3 made the codec standalone
+precisely so "a relay is Codec + psum, no Server needed"; this module
+cashes that in.
+
+A :class:`Relay` is a node in a reduction tree.  To its CHILDREN
+(slaves or lower relays) it is protocol-indistinguishable from the
+master: they dial its endpoint with the unchanged Client — same
+register handshake, same job/update commands, same reconnect/backoff/
+prefetch machinery.  To its UPSTREAM (the master or a higher relay) it
+is one slave-shaped peer that happens to speak two batched extensions
+of the same wire:
+
+  - **job batching**: ``{"cmd": "job", "count": k}`` fetches up to k
+    jobs with ONE params broadcast; the relay re-serves them to its
+    children on demand (a relay child asks with its own ``count``, so
+    the amplification compounds per level — at fanout F each tree
+    level divides the master's job-request decode count by ~F);
+  - **update aggregation**: child deltas are validated at the edge
+    (finite/shape/norm checks mirroring the master's quarantine, so one
+    poisoned child is refused HERE, never after corrupting a partial
+    sum), sum-reduced in float32, and flushed upward as ONE combined
+    delta re-encoded per ``root.common.engine.wire_dtype`` through a
+    :class:`wire.DeltaEncoder` — the relay keeps its own error-feedback
+    residuals, so re-quantizing the sum loses nothing over time — plus
+    a per-contributor manifest (slave ids, job ids, metrics, trace_ids)
+    the master uses to keep its accounting EXACT: Decision feeds,
+    quarantine counters, per-slave job history, adaptive-reap duration
+    samples and the requeue-per-child refusal policy all behave as if
+    each update had arrived individually.
+
+Failure semantics: a relay holds no training state — jobs sitting in
+its queue or contributions in its flush buffer when it dies are
+recovered by the master's existing TTL reaper (``jobs_requeued``), and
+its children fall back to the UPSTREAM endpoint the relay advertised in
+its register reply (the Client switches endpoints when its reconnect
+budget is spent and re-registers through the existing path).  A relay
+whose own upstream is gone for good stops serving, so its children see
+the same silence a dead master produces.
+
+Staleness note (documented, not hidden): batched job fetches share one
+params snapshot and the flush window delays updates by up to
+``relay_flush_s`` — both are the same delay-staleness the async
+protocol already exhibits whenever slaves interleave (and what the
+seeded tree-vs-star parity band in tests/test_relay.py covers).  A
+contributor whose job was reaped while its delta sat in a flush buffer
+is dropped from the master's books as stale while its (already-summed)
+delta lands — bounded by the flush window, far inside the adaptive reap
+timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.telemetry.metrics import registered_property as \
+    _relay_counter
+
+
+def parse_relay_spec(spec: str,
+                     default_bind: str = "tcp://*:5571"
+                     ) -> Tuple[str, str]:
+    """``--relay UPSTREAM[:BIND]`` -> (upstream, bind).  BIND may be a
+    full endpoint (``tcp://host:5570:tcp://*:5571``) or a bare port
+    (``tcp://host:5570:5571`` -> ``tcp://*:5571``); a plain endpoint
+    means "default bind".  Anything else raises with the accepted
+    forms spelled out (a typo must not silently bind the default)."""
+    import re
+
+    m = re.match(r"^(\w+://[^:/]+:\d+)$", spec)
+    if m:
+        return m.group(1), default_bind
+    m = re.match(r"^(\w+://[^:/]+:\d+):(\d+)$", spec)
+    if m:
+        return m.group(1), f"tcp://*:{m.group(2)}"
+    m = re.match(r"^(\w+://[^:/]+:\d+):(\w+://.+)$", spec)
+    if m:
+        return m.group(1), m.group(2)
+    raise ValueError(
+        f"unparseable --relay spec {spec!r}; expected "
+        "UPSTREAM, UPSTREAM:PORT or UPSTREAM:BIND_ENDPOINT "
+        "(e.g. tcp://host:5570:5571)")
+
+
+def plan_tree(n_slaves: int, fanout: int, master_endpoint: str,
+              host: str = "127.0.0.1", base_port: int = 15700) -> Dict:
+    """The ``--tree-fanout`` planner: the relay tiers a fleet of
+    ``n_slaves`` needs at ``fanout``, as concrete endpoints.
+
+    Returns ``{"relays": [{"bind", "upstream"}, ...],
+    "slave_endpoints": [endpoint per slave], "levels": n_levels}`` —
+    relays listed top tier (master's children) first, so starting them
+    in order brings the tree up parents-before-children.  Ports are
+    assigned sequentially from ``base_port``.
+    """
+    n_slaves = int(n_slaves)
+    fanout = int(fanout)
+    if n_slaves < 1:
+        raise ValueError(f"n_slaves must be >= 1, got {n_slaves}")
+    if fanout < 2:
+        # ceil(n / 1) never shrinks — a fanout-1 "tree" is a chain that
+        # aggregates nothing; refuse instead of looping forever
+        raise ValueError(f"tree fanout must be >= 2, got {fanout}")
+    # tier sizes bottom-up: each tier has ceil(below / fanout) nodes,
+    # until a tier fits under the master directly
+    tiers_up: List[int] = []
+    below = n_slaves
+    while below > fanout:
+        below = -(-below // fanout)          # ceil
+        tiers_up.append(below)
+    if not tiers_up and n_slaves > 1:
+        tiers_up.append(1)                   # one relay proves the hop
+    port = int(base_port)
+    relays: List[Dict[str, str]] = []
+    binds_by_tier: List[List[str]] = []
+    for count in reversed(tiers_up):         # top tier first
+        binds = []
+        for _ in range(count):
+            binds.append(f"tcp://{host}:{port}")
+            port += 1
+        binds_by_tier.append(binds)
+        upstreams = (binds_by_tier[-2] if len(binds_by_tier) > 1
+                     else [master_endpoint])
+        for i, bind in enumerate(binds):
+            relays.append({"bind": bind,
+                           "upstream": upstreams[i % len(upstreams)]})
+    leaves = binds_by_tier[-1] if binds_by_tier else [master_endpoint]
+    slave_endpoints = [leaves[i % len(leaves)] for i in range(n_slaves)]
+    return {"relays": relays, "slave_endpoints": slave_endpoints,
+            "levels": len(binds_by_tier)}
+
+
+class Relay:
+    """One reduction-tree node: ``serve()`` blocks (or ``start()`` runs
+    it on a daemon thread) until the upstream reports training done or
+    ``stop()`` is called.
+
+    No workflow needed: the relay validates its children's handshakes
+    by PASSING the first one upstream under its own id (the master's
+    version/digest check is the single source of truth) and caching the
+    validated credentials — later children are checked against the
+    cache locally, mismatches refused with the master's own wording.
+    """
+
+    #: registry counters (component="relay", labeled by bind) — the
+    #: ISSUE 10 families: name -> HELP text
+    COUNTERS = {
+        "relay_bytes_in": "wire bytes received (children + upstream)",
+        "relay_bytes_out": "wire bytes sent (children + upstream)",
+        "relay_refusals": "child deltas refused at the edge",
+        "relay_bad_frames": "undecodable child frames refused",
+        "relay_flushes": "aggregated updates flushed upstream",
+        "relay_contributions": "child update contributions accepted",
+        "relay_jobs_served": "jobs served to children",
+        "relay_upstream_reconnects": "fresh-socket retries upstream",
+    }
+
+    def __init__(self, upstream: str, bind: str,
+                 relay_id: Optional[str] = None, fanout: int = None,
+                 flush_s: float = None, recv_timeout: float = 15.0,
+                 max_reconnects: int = None, wire_dtype: str = None,
+                 child_ttl: float = None):
+        from znicz_tpu import telemetry
+        from znicz_tpu.core.config import root
+        from znicz_tpu.parallel import wire
+
+        self.upstream = upstream
+        self.bind = bind
+        self.relay_id = relay_id or f"relay-{uuid.uuid4().hex[:8]}"
+        #: flush threshold ~= the number of direct children expected to
+        #: contribute per round; also the job-batch amplification factor
+        self.fanout = int(
+            root.common.engine.get("tree_fanout", 2)
+            if fanout is None else fanout)
+        #: max age of a buffered contribution before a partial flush
+        self.flush_s = float(
+            root.common.engine.get("relay_flush_s", 0.05)
+            if flush_s is None else flush_s)
+        self.recv_timeout = float(recv_timeout)
+        self.max_reconnects = int(
+            root.common.engine.get("slave_reconnects", 8)
+            if max_reconnects is None else max_reconnects)
+        self.quarantine_norm_mult = float(
+            root.common.engine.get("quarantine_norm_mult", 25.0))
+        #: membership hygiene, the master's TTL rule at the relay tier:
+        #: a child silent this long leaves the table — a dead sibling
+        #: must not inflate the flush threshold (and the dashboard)
+        #: forever; a re-register brings it straight back
+        self.child_ttl = float(
+            root.common.engine.get("slave_ttl", 60.0)
+            if child_ttl is None else child_ttl)
+        #: upward re-encoding of the summed delta, with the relay's OWN
+        #: error-feedback residuals (re-quantization loses nothing over
+        #: time; leaves keep their own residuals independently)
+        self.wire_dtype = wire.canonical_wire_dtype(
+            root.common.engine.get("wire_dtype", "float32")
+            if wire_dtype is None else wire_dtype)
+        self._enc = wire.DeltaEncoder(self.wire_dtype)
+
+        #: ONE lock guards every field the serve thread mutates that
+        #: stats()/web_status read (the thread-shared-state discipline,
+        #: znicz-lint enforced — no pragmas)
+        self._lock = threading.Lock()
+        self._children: Dict[str, float] = {}       # id -> last seen
+        self._cred: Optional[Tuple[Any, Any]] = None  # (version, digest)
+        self._cred_reply: Dict = {}                 # cached ok register
+        self._jobq: List[Tuple[dict, Any]] = []     # (entry, params)
+        self._buffer: List[dict] = []               # contributor entries
+        self._buffer_msgs = 0                       # direct child msgs
+        self._sum: Dict[str, Dict[str, np.ndarray]] = {}
+        #: shapes learned from the first ACCEPTED delta, for the
+        #: relay's lifetime — the in-progress sum is empty at the start
+        #: of every flush window, so without this a wrong-shaped child
+        #: arriving first would seed the aggregate and get its healthy
+        #: siblings refused instead of itself
+        self._shapes: Dict[str, Dict[str, tuple]] = {}
+        self._sum_t0: Optional[float] = None
+        self._done = False
+        #: wait-damping: when the upstream says "wait" (epoch tail), a
+        #: relay must not re-ask upstream on EVERY child poll — that
+        #: would multiply the master's decode count by the subtree size
+        #: instead of dividing it.  Children polling inside this window
+        #: get "wait" locally; consecutive upstream waits grow the
+        #: window exponentially (capped), so a long drain costs a
+        #: handful of upstream polls, not a stream of them.
+        self._wait_until = 0.0
+        self._wait_streak = 0
+        self._delta_norms: List[float] = []         # accepted, per-child
+        self._uregistered = False
+        self._ufails = 0
+        self._urefusals = 0             # consecutive bad_frame replies
+        self._usock = None
+        self._last_evict = 0.0
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        sc = telemetry.scope("relay", bind=str(bind))
+        self._m = {name: sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        from znicz_tpu.telemetry.metrics import weak_fn
+
+        sc.gauge("relay_children", "children registered at this relay",
+                 fn=weak_fn(self, lambda r: len(r._children)))
+        sc.gauge("relay_queue_depth", "jobs queued for children",
+                 fn=weak_fn(self, lambda r: len(r._jobq)))
+        self._tracer = telemetry.tracer()
+
+    # -- introspection ---------------------------------------------------------
+
+    @property
+    def children(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._children)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._jobq)
+
+    @property
+    def complete(self) -> bool:
+        with self._lock:
+            return self._done
+
+    def stats(self) -> dict:
+        """The web_status tree-topology panel's row (assembled under the
+        lock; plain values only)."""
+        now = time.time()
+        with self._lock:
+            children = [{"id": sid, "last_seen_s": round(now - seen, 1)}
+                        for sid, seen in sorted(self._children.items())]
+            queued = len(self._jobq)
+            buffered = len(self._buffer)
+            done = self._done
+        return {
+            "id": self.relay_id, "bind": self.bind,
+            "upstream": self.upstream, "fanout": self.fanout,
+            "wire_dtype": self.wire_dtype,
+            "children": children, "queue_depth": queued,
+            "buffered_contributions": buffered, "complete": done,
+            "bytes_in": self.bytes_in, "bytes_out": self.bytes_out,
+            "refusals": self.refusals, "flushes": self.flushes,
+            "contributions": self.contributions,
+            "jobs_served": self.jobs_served,
+            "bad_frames": self.bad_frames,
+            "upstream_reconnects": self.upstream_reconnects,
+        }
+
+    # -- child-side edge validation (the quarantine mirror) --------------------
+
+    def _validate_delta(self, deltas: Dict, n_delta: int) -> Optional[str]:
+        """Refusal reason for a child delta that must never touch the
+        partial sum: a leaf whose shape disagrees with the aggregate so
+        far (summing would raise or broadcast garbage), any non-finite
+        value, or a per-contributor norm beyond ``quarantine_norm_mult``
+        x the running median of accepted per-contributor norms — the
+        master's quarantine, applied at the edge so one poisoned child
+        is refused HERE.  NEVER raises (a payload too broken to inspect
+        is itself the reason)."""
+        try:
+            total = 0.0
+            for name, layer in deltas.items():
+                for k, arr in (layer or {}).items():
+                    a = np.asarray(arr, np.float64)
+                    # learned lifetime shapes first (the sum is empty
+                    # at each window start), then the live aggregate
+                    want = self._shapes.get(name, {}).get(k)
+                    if want is not None and tuple(a.shape) != want:
+                        return (f"shape {tuple(a.shape)} != {want} "
+                                f"for {name}.{k}")
+                    have = self._sum.get(name, {}).get(k)
+                    if have is not None and have.shape != a.shape:
+                        return (f"shape {tuple(a.shape)} != aggregate "
+                                f"{tuple(have.shape)} for {name}.{k}")
+                    if not np.all(np.isfinite(a)):
+                        return "non-finite values"
+                    total += float(np.dot(a.ravel(), a.ravel()))
+        except Exception as exc:
+            return f"undecodable delta payload: {exc!r}"
+        # per-contributor normalization: a relay child's aggregate of n
+        # deltas carries ~n contributors' worth of norm
+        norm = float(np.sqrt(total)) / max(1, int(n_delta))
+        with self._lock:
+            if len(self._delta_norms) >= 5:
+                med = float(np.median(self._delta_norms))
+                if med > 0.0 and norm > self.quarantine_norm_mult * med:
+                    return (f"norm {norm:.3g} > "
+                            f"{self.quarantine_norm_mult:g} x median "
+                            f"{med:.3g}")
+            self._delta_norms.append(norm)
+            del self._delta_norms[:-64]
+        return None
+
+    def _accumulate(self, deltas: Dict) -> None:
+        with self._lock:
+            for name, layer in deltas.items():
+                dst = self._sum.setdefault(name, {})
+                shp = self._shapes.setdefault(name, {})
+                for k, arr in (layer or {}).items():
+                    a = np.asarray(arr, np.float32)
+                    shp.setdefault(k, tuple(a.shape))
+                    if k in dst:
+                        dst[k] = dst[k] + a
+                    else:
+                        dst[k] = a.astype(np.float32, copy=True)
+            if self._sum_t0 is None:
+                self._sum_t0 = time.time()
+
+    # -- child command handlers ------------------------------------------------
+
+    def _child_register(self, req: dict, sid: str) -> dict:
+        v, digest = req.get("version"), req.get("workflow_digest")
+        with self._lock:
+            cred = self._cred
+        if cred is None:
+            # first child: ITS credentials become the relay's own
+            # registration upstream — the master's check_handshake is
+            # the single source of truth for the whole subtree
+            rep = self._upstream_rpc(
+                {"cmd": "register", "id": self.relay_id, "version": v,
+                 "workflow_digest": digest, "relay": True,
+                 "fanout": self.fanout}, is_register=True)
+            if rep is None:
+                return {"ok": False,
+                        "error": "relay upstream unreachable"}
+            if not rep.get("ok"):
+                return {"ok": False, "error": rep.get("error")}
+            with self._lock:
+                self._cred = (v, digest)
+                self._cred_reply = {
+                    k: rep.get(k)
+                    for k in ("version", "class_lengths", "resumed",
+                              "epoch")}
+            self._uregistered = True
+        else:
+            # validated subtree: later children are checked locally,
+            # refused with the master's own wording on mismatch
+            cv, cd = cred
+            if v != cv:
+                return {"ok": False, "error":
+                        f"protocol version mismatch: master speaks "
+                        f"{cv}, slave sent {v!r}"}
+            if digest != cd:
+                return {"ok": False, "error":
+                        f"workflow digest mismatch: master runs {cd}, "
+                        f"slave runs {digest!r} — same trainable graph "
+                        f"(layer names/shapes/hyperparameters) required"}
+        with self._lock:
+            self._children[sid] = time.time()
+            reply = dict(self._cred_reply)
+        reply.update({"ok": True, "upstream": self.upstream})
+        return reply
+
+    def _child_job(self, req: dict, sid: str) -> dict:
+        k = max(1, min(int(req.get("count", 1) or 1), 64))
+        with self._lock:
+            done, have = self._done, len(self._jobq)
+            damped = not have and time.time() < self._wait_until
+        if done:
+            return {"done": True}
+        if damped:
+            return {"wait": True}           # upstream said wait just now
+        if have == 0:
+            rep = self._upstream_rpc(
+                {"cmd": "job", "id": self.relay_id,
+                 "count": k * self.fanout,
+                 "prefetch": bool(req.get("prefetch"))})
+            if rep is None:
+                return {"wait": True}       # upstream fault: child re-asks
+            if rep.get("done"):
+                self._flush()               # drain before the drain ends
+                with self._lock:
+                    self._done = True
+                    self._jobq.clear()      # issued jobs are dead weight
+                return {"done": True}
+            # (no `unregistered` handling here: _upstream_rpc consumes
+            # it internally — re-register + resend — for every
+            # non-register call)
+            jobs = rep.get("jobs")
+            if jobs is None and "job" in rep:
+                jobs = [{key: rep.get(key)
+                         for key in ("job_id", "job", "trace_id",
+                                     "train")}]
+            if not jobs:
+                # upstream wait (epoch tail): damp the subtree's polls
+                # so they do not all re-ask the master
+                with self._lock:
+                    self._wait_streak += 1
+                    damp = min(0.05 * (2 ** min(self._wait_streak - 1,
+                                                4)), 0.5)
+                    self._wait_until = time.time() + damp
+                return {"wait": True}
+            params = rep.get("params")
+            with self._lock:
+                self._wait_streak = 0
+                self._jobq.extend((dict(j), params) for j in jobs)
+        with self._lock:
+            take = self._jobq[:k]
+            del self._jobq[:k]
+        if not take:
+            return {"wait": True}
+        self._m["relay_jobs_served"].inc(len(take))
+        params = take[-1][1]                # freshest batch's params
+        if int(req.get("count", 1) or 1) <= 1:
+            entry = take[0][0]
+            return dict(entry, params=take[0][1])
+        return {"jobs": [e for e, _ in take], "params": params}
+
+    def _child_update(self, req: dict, sid: str) -> dict:
+        deltas = req.get("deltas")
+        contributors = req.get("contributors")
+        if contributors is not None:
+            # a lower relay's aggregate: adopt its manifest wholesale
+            entries = [dict(e) for e in contributors]
+            n_delta = sum(1 for e in entries if e.get("delta"))
+        else:
+            entries = [{"id": sid, "job_id": req.get("job_id"),
+                        "trace_id": req.get("trace_id"),
+                        "metrics": req.get("metrics")}]
+            n_delta = 1 if deltas else 0
+            if deltas:
+                entries[0]["delta"] = True
+        if deltas:
+            reason = self._validate_delta(deltas, max(1, n_delta))
+            if reason:
+                # refused at the edge: the partial sum stays clean, the
+                # child hears the master's quarantine wording, and the
+                # manifest still reports the refusal upstream so the
+                # master counts it and requeues the job per child.
+                # ONLY delta-bearing entries are refused — a delta-less
+                # sibling (eval metrics) in the same aggregate had
+                # nothing in the refused sum, so its finished work
+                # passes through intact
+                refused = [{"id": e.get("id", sid),
+                            "job_id": e.get("job_id"),
+                            "refused": reason}
+                           for e in entries if e.get("delta")]
+                passed = [e for e in entries if not e.get("delta")]
+                with self._lock:
+                    self._buffer.extend(refused + passed)
+                    self._buffer_msgs += 1
+                    if self._sum_t0 is None:
+                        self._sum_t0 = time.time()
+                self._m["relay_refusals"].inc(len(refused))
+                if passed:
+                    self._m["relay_contributions"].inc(len(passed))
+                self._maybe_flush()
+                return {"ok": False, "quarantined": True,
+                        "error": f"delta quarantined: {reason}"}
+            self._accumulate(deltas)
+        with self._lock:
+            self._buffer.extend(entries)
+            self._buffer_msgs += 1
+            if self._sum_t0 is None:
+                self._sum_t0 = time.time()
+            done = self._done
+        self._m["relay_contributions"].inc(len(entries))
+        self._maybe_flush()
+        return {"ok": True, "complete": done}
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        sid = req.get("id", "?")
+        with self._lock:                # one acquisition per message:
+            known = sid in self._children   # membership + last-seen
+            if known:
+                self._children[sid] = time.time()
+        if cmd == "register":
+            return self._child_register(req, sid)
+        if cmd in ("job", "update") and not known:
+            return {"ok": False, "unregistered": True,
+                    "error": f"slave {sid!r} is not registered"}
+        if cmd == "job":
+            return self._child_job(req, sid)
+        if cmd == "update":
+            return self._child_update(req, sid)
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    # -- the flush -------------------------------------------------------------
+
+    def _flush_due(self) -> bool:
+        with self._lock:
+            if not self._buffer:
+                return False
+            if self._buffer_msgs >= max(
+                    1, min(len(self._children), self.fanout)):
+                return True
+            return (self._sum_t0 is not None
+                    and time.time() - self._sum_t0 >= self.flush_s)
+
+    def _maybe_flush(self) -> None:
+        if self._flush_due():
+            self._flush()
+
+    def _evict_children(self) -> None:
+        """Drop children silent past ``child_ttl`` (checked at most
+        once per second); their in-flight work recovers via the
+        master's reaper, and a returning child re-registers through the
+        existing unregistered-reply path."""
+        if self.child_ttl <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            if now - self._last_evict < 1.0:
+                return
+            self._last_evict = now
+            for sid in [s for s, seen in self._children.items()
+                        if now - seen > self.child_ttl]:
+                del self._children[sid]
+
+    def _flush_message(self, entries: List[dict],
+                       summed: Optional[Dict]) -> dict:
+        """The ONE home for the aggregated-update message shape (the
+        byte-identity test builds flushes through this too): contributor
+        manifest + the summed delta re-encoded per ``wire_dtype`` with
+        this relay's error-feedback residuals."""
+        return {"cmd": "update", "id": self.relay_id,
+                "contributors": entries,
+                "deltas": self._enc.encode(summed) if summed else None}
+
+    def _flush(self, final: bool = False) -> None:
+        """Ship the buffered contributions upstream as ONE aggregated
+        update: summed f32 deltas re-encoded per wire_dtype (error
+        feedback in :attr:`_enc`) + the contributor manifest.
+        ``final`` (the serve loop's last act) allows one delivery
+        attempt even after ``stop()`` — a clean shutdown should not
+        silently drop a flush window a healthy upstream would take."""
+        from znicz_tpu.parallel import wire
+
+        with self._lock:
+            if not self._buffer:
+                return
+            entries, self._buffer = self._buffer, []
+            self._buffer_msgs = 0
+            summed, self._sum = self._sum, {}
+            self._sum_t0 = None
+        t0 = time.perf_counter() if self._tracer.enabled else None
+        frames, _ = wire.encode_message(self._flush_message(entries,
+                                                           summed))
+        rep = self._upstream_rpc(frames=frames, one_shot=final)
+        if rep is not None:
+            # only a DELIVERED flush counts — rep None means not a
+            # byte was sent (stop mid-run, upstream budget spent) and
+            # the jobs behind these contributions come back via the
+            # master's TTL reaper
+            self._m["relay_flushes"].inc()
+        if t0 is not None:
+            self._tracer.add("relay", "flush", t0,
+                             time.perf_counter() - t0,
+                             {"contributors": len(entries),
+                              "delivered": rep is not None,
+                              "bind": self.bind})
+        if rep is not None and rep.get("complete"):
+            with self._lock:
+                self._done = True
+
+    # -- the upstream link -----------------------------------------------------
+
+    def _connect_upstream(self):
+        import zmq
+
+        sock = zmq.Context.instance().socket(zmq.REQ)
+        sock.setsockopt(zmq.REQ_RELAXED, 1)
+        sock.setsockopt(zmq.REQ_CORRELATE, 1)
+        sock.setsockopt(zmq.RCVTIMEO, int(self.recv_timeout * 1000))
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(self.upstream)
+        return sock
+
+    def _upstream_rpc(self, msg: Optional[dict] = None,
+                      frames: Optional[List] = None,
+                      is_register: bool = False,
+                      one_shot: bool = False) -> Optional[dict]:
+        """One REQ/REP exchange with the upstream, riding the client's
+        fault model: a timeout or undecodable reply closes the (EFSM-
+        broken) socket, backs off and reconnects fresh — re-registering
+        with the cached credentials before any further traffic — and
+        re-sends the SAME frames.  Returns None once the reconnect
+        budget is spent (the caller treats the upstream as gone).
+        ``one_shot`` permits a single attempt even after ``stop()`` —
+        the serve loop's final flush."""
+        import random
+
+        import zmq
+
+        from znicz_tpu.parallel import wire
+
+        if frames is None:
+            frames, _ = wire.encode_message(msg)
+        rng = random.Random(f"{self.relay_id}/backoff/{self._ufails}")
+        attempts = 0
+        while not self._stop.is_set() or (one_shot and attempts == 0):
+            attempts += 1
+            try:
+                if self._usock is None:
+                    self._usock = self._connect_upstream()
+                if not self._uregistered and not is_register:
+                    cred = self._cred
+                    if cred is None:
+                        return None     # nothing to re-register as yet
+                    reg, _ = wire.encode_message(
+                        {"cmd": "register", "id": self.relay_id,
+                         "version": cred[0], "workflow_digest": cred[1],
+                         "relay": True, "fanout": self.fanout})
+                    rep = self._exchange(reg)
+                    if rep.get("bad_frame"):
+                        if self._count_refusal():
+                            return None
+                        continue        # alive, never decoded: resend
+                    if not rep.get("ok"):
+                        import logging
+
+                        logging.getLogger("znicz").warning(
+                            "%s: upstream refused re-registration: %s",
+                            self.relay_id, rep.get("error"))
+                        self._stop.set()
+                        return None
+                    self._uregistered = True
+                rep = self._exchange(frames)
+                self._ufails = 0
+                if rep.get("bad_frame"):
+                    # the upstream is alive but never decoded our frame
+                    # (chaos corrupted the request): resend the SAME
+                    # bytes, bounded like the client's refusal cap — a
+                    # bad_frame reply is NOT a refusal of the content
+                    if self._count_refusal():
+                        return None
+                    continue
+                self._urefusals = 0
+                if rep.get("unregistered") and not is_register:
+                    self._uregistered = False   # master restarted
+                    continue                    # re-register + resend
+                return rep
+            except (zmq.Again, wire.WireError, TypeError) as exc:
+                self._ufails += 1
+                self._m["relay_upstream_reconnects"].inc()
+                if self._usock is not None:
+                    self._usock.close(0)
+                    self._usock = None
+                self._uregistered = False
+                if self._ufails > self.max_reconnects:
+                    import logging
+
+                    logging.getLogger("znicz").warning(
+                        "%s: upstream %s gone for good after %d retries "
+                        "(%r) — relay going silent so children fall "
+                        "back", self.relay_id, self.upstream,
+                        self._ufails - 1, exc)
+                    self._stop.set()
+                    return None
+                delay = min(2.0, 0.05 * (2 ** min(self._ufails - 1, 5)))
+                time.sleep(delay * (0.5 + rng.random()))
+        return None
+
+    def _count_refusal(self) -> bool:
+        """Bounded bad_frame retry budget (the client's ``refused()``
+        policy): True once spent — an upstream that refuses EVERY frame
+        we send (deterministic corruption, version skew) must not spin
+        us forever."""
+        self._urefusals += 1
+        if self._urefusals <= max(3, self.max_reconnects):
+            time.sleep(0.05)
+            return False
+        import logging
+
+        logging.getLogger("znicz").warning(
+            "%s: upstream refused %d consecutive frames (bad_frame) — "
+            "relay going silent", self.relay_id, self._urefusals)
+        self._stop.set()
+        return True
+
+    def _exchange(self, frames: List) -> dict:
+        """send/recv one frame stack on the live upstream socket; raises
+        zmq.Again / WireError / TypeError on faults (handled by the rpc
+        retry loop)."""
+        from znicz_tpu.parallel import wire
+
+        self._m["relay_bytes_out"].inc(
+            sum(f.nbytes if isinstance(f, memoryview) else len(f)
+                for f in frames))
+        self._usock.send_multipart(frames, copy=False)
+        raw = self._usock.recv_multipart()
+        self._m["relay_bytes_in"].inc(sum(len(f) for f in raw))
+        rep, _ = wire.decode_message(raw)
+        if not isinstance(rep, dict):
+            raise TypeError(f"reply decodes to {type(rep).__name__}")
+        return rep
+
+    # -- the serve loop --------------------------------------------------------
+
+    def _reply_frames(self, frames: List[bytes]) -> List:
+        """Decode + dispatch one child message; NEVER raises (the
+        master's own refusal discipline: garbage is counted and refused
+        in legacy framing, not fatal)."""
+        import logging
+        import pickle
+
+        from znicz_tpu.parallel import wire
+
+        self._m["relay_bytes_in"].inc(sum(len(f) for f in frames))
+        try:
+            req, info = wire.decode_message(frames)
+            if not isinstance(req, dict):
+                raise wire.WireError(
+                    f"decodes to {type(req).__name__}, not a request "
+                    f"dict")
+        except Exception as exc:
+            self._m["relay_bad_frames"].inc()
+            rep_frames = [pickle.dumps(
+                {"ok": False, "bad_frame": True,
+                 "error": f"bad frame: {exc}"})]
+            self._m["relay_bytes_out"].inc(
+                sum(len(f) for f in rep_frames))
+            return rep_frames
+        legacy = bool(info.get("legacy"))
+        try:
+            with self._tracer.span("relay", f"handle:{req.get('cmd')}",
+                                   bind=self.bind, child=req.get("id")):
+                rep = self._handle(req)
+        except Exception as exc:
+            self._m["relay_bad_frames"].inc()
+            logging.getLogger("znicz").exception(
+                "%s: refused malformed request %r", self.relay_id,
+                req.get("cmd"))
+            rep = {"ok": False, "bad_frame": True,
+                   "error": f"malformed request: {exc!r}"}
+        if legacy:
+            out = [pickle.dumps(rep)]
+        else:
+            out, _ = wire.encode_message(rep)
+        self._m["relay_bytes_out"].inc(
+            sum(f.nbytes if isinstance(f, memoryview) else len(f)
+                for f in out))
+        return out
+
+    def serve(self, linger: float = 3.0) -> None:
+        """Blocks until the upstream reports done (then keeps draining
+        ``linger`` seconds so late children get their ``done``) or
+        ``stop()``.  Binds lazily with the master's EADDRINUSE retry so
+        a restarted relay can race its predecessor's port release."""
+        import zmq
+
+        from znicz_tpu.network_common import bind_with_retry
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.REP)
+        bind_with_retry(sock, self.bind)
+        self._ready.set()
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        deadline = None
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    done = self._done and not self._buffer
+                if done and deadline is None:
+                    deadline = time.time() + linger
+                if deadline is not None and time.time() > deadline:
+                    break
+                if poller.poll(20):
+                    frames = sock.recv_multipart()
+                    sock.send_multipart(self._reply_frames(frames),
+                                        copy=False)
+                self._maybe_flush()
+                self._evict_children()
+        finally:
+            # one delivery attempt even when stop() ended the loop — a
+            # clean shutdown should not drop a window a healthy
+            # upstream would take (undeliverable: the TTL reaper pays)
+            self._flush(final=True)
+            sock.close(0)
+            if self._usock is not None:
+                self._usock.close(0)
+                self._usock = None
+
+    def start(self, linger: float = 3.0) -> "Relay":
+        self._thread = threading.Thread(
+            target=self.serve, kwargs={"linger": linger}, daemon=True,
+            name=f"relay-{self.bind}")
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError(f"relay failed to bind {self.bind}")
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+# historical-style counter attributes (relay.refusals, relay.bytes_in,
+# ...) generated from COUNTERS — one source of truth per counter
+for _name, _help in Relay.COUNTERS.items():
+    setattr(Relay, _name[len("relay_"):], _relay_counter(_name, _help))
+del _name, _help

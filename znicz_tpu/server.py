@@ -99,6 +99,10 @@ class Server:
         self.registered: set = set()                # handshake-passed ids
         self.dead_slaves: Dict[str, float] = {}     # evicted id -> last seen
         self._ever_registered: set = set()
+        #: ids that registered with ``relay=True`` (ISSUE 10): direct
+        #: children that are aggregation-tree relays, not leaf slaves —
+        #: the web_status topology panel marks them
+        self.relays: set = set()
         # -- telemetry (ISSUE 5): every master counter lives in the
         # process-wide registry (exported on /metrics) under
         # component="master"; the class-level _server_counter properties
@@ -152,6 +156,20 @@ class Server:
         self.quarantine_norm_mult = float(
             root.common.engine.get("quarantine_norm_mult", 25.0))
         self._param_shapes = None       # lazy {layer: {param: shape}}
+        # -- LR schedules under master/slave (ISSUE 10 satellite): the
+        # master owns the train-iteration clock.  Any LearningRateAdjust
+        # unit's policy bindings are evaluated HERE at dispatch and the
+        # scheduled per-layer (lr, lr_bias) ships inside each TRAIN
+        # minibatch's payload — slaves apply them per job, so schedules
+        # advance exactly as in local training (modulo the async
+        # reordering the protocol already has)
+        from znicz_tpu.lr_adjust import LearningRateAdjust
+
+        self._lr_bindings = []
+        for u in workflow.units:
+            if isinstance(u, LearningRateAdjust):
+                self._lr_bindings.extend(u._bindings)
+        self._lr_iteration = 0          # TRAIN minibatches dispatched
         #: crash-resume: when set, serve() writes the master's full
         #: training state here every snapshot_every_s seconds, and a
         #: Server constructed while the file exists restores from it
@@ -199,6 +217,7 @@ class Server:
         "updates_received": "update messages seen (any outcome)",
         "update_bytes_in": "wire bytes of update messages",
         "prefetch_hit": "jobs served to prefetch requests",
+        "aggregated_updates": "pre-aggregated relay updates accepted",
     }
 
     # (the historical attribute properties are generated from COUNTERS
@@ -283,7 +302,8 @@ class Server:
             logging.getLogger("znicz").info(
                 "slave %s evicted (silent for %.0fs)", sid, self.slave_ttl)
 
-    def _quarantine_reason(self, deltas: Dict) -> Optional[str]:
+    def _quarantine_reason(self, deltas: Dict,
+                           n_contrib: int = 1) -> Optional[str]:
         """Refusal reason for a delta payload that must never touch the
         global params: a leaf whose shape does not match the target param
         (apply_deltas would raise mid-apply, tearing the update), any
@@ -291,9 +311,13 @@ class Server:
         ``quarantine_norm_mult`` x the running median of accepted-update
         norms (>= 5 samples).  Accepted norms feed the history;
         quarantined ones do not (a diverging slave must not drag the
-        median up to its own level).  NEVER raises — a payload too broken
-        to inspect is itself the quarantine reason (by the time this
-        runs the job has left _inflight, so an exception would lose it)."""
+        median up to its own level).  ``n_contrib`` > 1 (a relay's
+        pre-aggregated sum of that many child deltas, ISSUE 10)
+        normalizes the norm per contributor, so the history and the
+        threshold stay comparable between star and tree topologies.
+        NEVER raises — a payload too broken to inspect is itself the
+        quarantine reason (by the time this runs the job has left
+        _inflight, so an exception would lose it)."""
         try:
             if self._param_shapes is None:   # fixed after initialize()
                 self._param_shapes = {
@@ -314,7 +338,7 @@ class Server:
                     total += float(np.dot(a.ravel(), a.ravel()))
         except Exception as exc:
             return f"undecodable delta payload: {exc!r}"
-        norm = float(np.sqrt(total))
+        norm = float(np.sqrt(total)) / max(1, int(n_contrib))
         if len(self._delta_norms) >= 5:
             med = float(np.median(self._delta_norms))
             if med > 0.0 and norm > self.quarantine_norm_mult * med:
@@ -323,6 +347,25 @@ class Server:
         self._delta_norms.append(norm)
         return None
 
+    def _scheduled_hypers(self) -> Optional[Dict]:
+        """The per-layer (lr, lr_bias) a TRAIN minibatch dispatched at
+        the CURRENT train iteration should use, per the workflow's
+        LearningRateAdjust bindings — the unit-path clock exactly:
+        minibatch k trains at the rate lr_adjust wrote after minibatch
+        k-1 (``pol(base, k-1)``; minibatch 0 at the configured base)."""
+        if not self._lr_bindings:
+            return None
+        it = self._lr_iteration
+        out = {}
+        for gd, base, base_bias, pol, bias_pol in self._lr_bindings:
+            if it == 0:
+                lr, lr_bias = base, base_bias
+            else:
+                lr, lr_bias = pol(base, it - 1), bias_pol(base_bias,
+                                                          it - 1)
+            out[gd.forward.name] = (float(lr), float(lr_bias))
+        return out
+
     def _advance_mb(self) -> dict:
         if self._hold is not None:
             mb, self._hold = self._hold, None
@@ -330,7 +373,7 @@ class Server:
         self.loader.run()
         import numpy as np
 
-        return {
+        mb = {
             "indices": np.array(self.loader.minibatch_indices.mem).copy(),
             "class": int(self.loader.minibatch_class),
             "size": int(self.loader.minibatch_size),
@@ -338,6 +381,15 @@ class Server:
             "class_ended": bool(self.loader.class_ended),
             "epoch_number": int(self.loader.epoch_number),
         }
+        if mb["class"] == TRAIN:
+            # scheduled hypers ride the minibatch payload (a re-queued
+            # job keeps its stamp: the schedule is per-minibatch, not
+            # per-delivery); relays forward job payloads opaquely
+            hypers = self._scheduled_hypers()
+            if hypers:
+                mb["hypers"] = hypers
+            self._lr_iteration += 1
+        return mb
 
     def _outstanding(self):
         return [j for j, _, _ in self._inflight.values()] + self._pending
@@ -467,6 +519,7 @@ class Server:
                 for j in self._outstanding()],
             "job_seq": self._job_seq,
             "jobs_by_slave": dict(self.jobs_by_slave),
+            "lr_iteration": self._lr_iteration,
             "decision_acc": acc,
             "durations": list(self._durations),
             "delta_norms": list(self._delta_norms),
@@ -483,6 +536,7 @@ class Server:
                 "updates_received": self.updates_received,
                 "update_bytes_in": self.update_bytes_in,
                 "prefetch_hit": self.prefetch_hit,
+                "aggregated_updates": self.aggregated_updates,
                 "tensor_bytes_raw_in": self.tensor_bytes_raw_in,
                 "tensor_bytes_wire_in": self.tensor_bytes_wire_in,
                 "tensor_bytes_raw_out": self.tensor_bytes_raw_out,
@@ -519,6 +573,7 @@ class Server:
         # freshly-issued id (it would be applied against the wrong job)
         self._job_seq = int(m.get("job_seq", 0)) + 100_000
         self.jobs_by_slave = dict(m.get("jobs_by_slave", {}))
+        self._lr_iteration = int(m.get("lr_iteration", 0))
         self._durations = collections.deque(m.get("durations", []),
                                             maxlen=64)
         self._delta_norms = collections.deque(m.get("delta_norms", []),
@@ -567,20 +622,12 @@ class Server:
         not be orphaned — the slave would block in recv forever)."""
         import zmq
 
+        from znicz_tpu.network_common import bind_with_retry
+
         ctx = zmq.Context.instance()
         self._stop = False
         self._socket = ctx.socket(zmq.REP)
-        # a restarted master can race the dying one's port release;
-        # retry ONLY that race — any other bind error (bad host, EACCES)
-        # is permanent and must surface immediately
-        for attempt in range(40):
-            try:
-                self._socket.bind(self.endpoint)
-                break
-            except zmq.error.ZMQError as exc:
-                if exc.errno != zmq.EADDRINUSE or attempt == 39:
-                    raise
-                time.sleep(0.05)
+        bind_with_retry(self._socket, self.endpoint)
         poller = zmq.Poller()
         poller.register(self._socket, zmq.POLLIN)
         deadline = None
@@ -686,6 +733,11 @@ class Server:
                 self._m["reregistrations"].inc()
             self._ever_registered.add(sid)
             self.registered.add(sid)
+            if req.get("relay"):
+                # an aggregation-tree relay (ISSUE 10): a first-class
+                # member (TTL, eviction, reap all apply), marked so the
+                # topology panel can draw the tree
+                self.relays.add(sid)
             self.slaves[sid] = time.time()
             return {"ok": True, "version": PROTOCOL_VERSION,
                     "class_lengths": list(self.loader.class_lengths),
@@ -701,27 +753,43 @@ class Server:
         if cmd == "job":
             if bool(self.decision.complete):
                 return {"done": True}
-            job = self._next_job()
-            if job is None:
+            # batched fetch (ISSUE 10): a relay asks with count=k and
+            # gets up to k jobs under ONE params broadcast — the
+            # O(slaves) -> O(fanout) flip on the job-request side.  A
+            # count-less request keeps the historical flat reply shape.
+            count = max(1, min(int(req.get("count", 1) or 1), 64))
+            entries: List[dict] = []
+            job = None
+            for _ in range(count):
+                job = self._next_job()
+                if job is None or job is self._WAIT:
+                    break
+                self._job_seq += 1
+                jid = self._job_seq
+                self._inflight[jid] = (job, time.time(), sid)
+                # trace_id: the cross-process correlation key (ISSUE
+                # 5).  It rides the v3 metadata frame as an OPTIONAL
+                # dict key — the slave echoes it in the update, spans
+                # on both sides carry it, and an old peer that ignores
+                # it still works.
+                entries.append({"job_id": jid, "job": job,
+                                "trace_id": f"{self._run_tag}-{jid}",
+                                "train": job["class"] == TRAIN})
+            if not entries:
+                if job is self._WAIT:
+                    return {"wait": True}   # client sleeps and re-asks
                 return {"done": True}
-            if job is self._WAIT:
-                return {"wait": True}       # client sleeps and re-asks
-            self._job_seq += 1
-            jid = self._job_seq
-            self._inflight[jid] = (job, time.time(), sid)
             if req.get("prefetch"):
                 # the client's pipeline socket asked for this job ahead
                 # of need — the fetch overlapped compute (ISSUE 3)
                 self._m["prefetch_hit"].inc()
-            # trace_id: the cross-process correlation key (ISSUE 5).  It
-            # rides the v3 metadata frame as an OPTIONAL dict key — the
-            # slave echoes it in the update, spans on both sides carry
-            # it, and an old peer that ignores it still works.
-            return {"job_id": jid, "job": job,
-                    "trace_id": f"{self._run_tag}-{jid}",
-                    "params": self.snapshot_params(),
-                    "train": job["class"] == TRAIN}
+            params = self.snapshot_params()
+            if count <= 1:
+                return dict(entries[0], params=params)
+            return {"jobs": entries, "params": params}
         if cmd == "update":
+            if "contributors" in req:
+                return self._handle_aggregated(req, sid)
             jid = req.get("job_id")
             entry = self._inflight.pop(jid, None)
             if entry is None:
@@ -791,6 +859,137 @@ class Server:
             self.jobs_by_slave[sid] = self.jobs_by_slave.get(sid, 0) + 1
             return {"ok": True, "complete": bool(self.decision.complete)}
         return {"error": f"unknown cmd {cmd!r}"}
+
+    def _handle_aggregated(self, req: dict, sid: str) -> dict:
+        """A relay's pre-aggregated update (ISSUE 10): ONE summed delta
+        plus a per-contributor manifest.  The accounting mirrors the
+        star EXACTLY, per contributor: stale jobs dropped and counted,
+        relay-edge refusals counted as quarantined and re-queued,
+        malformed metrics refused under the bounded MAX_BAD_REPLIES
+        policy, round-trip durations feeding the adaptive reaper, the
+        Decision fed per minibatch in manifest order, and ``jobs_done``
+        attributed to the LEAF slave ids.  The summed delta passes the
+        same quarantine (norm normalized per contributing delta) and is
+        applied ONCE; when IT is refused, every fresh contributor's job
+        is re-queued — the sum is indivisible, so none of its inputs
+        may land (requeue-per-child).  The same indivisibility rule
+        runs the other way: a DELTA-BEARING contributor refused for
+        malformed metrics aborts the whole aggregate (the star's order
+        is refuse-BEFORE-apply, and its gradient cannot be subtracted
+        from the sum) — innocent siblings are re-queued without a
+        strike, so nothing lands twice when the re-dispatched jobs
+        come back.
+
+        Documented staleness: a contributor reaped while its delta sat
+        in a relay flush buffer is dropped from the books here while
+        its (already-summed) share of the delta lands — bounded by the
+        relay flush window, far inside the adaptive reap timeout."""
+        contributors = req.get("contributors")
+        if not isinstance(contributors, (list, tuple)) or not all(
+                isinstance(c, dict) for c in contributors):
+            # raises out to _reply_frames' bad-frame refusal: nothing
+            # has been popped from _inflight yet, so nothing is lost
+            raise ValueError("contributors manifest is not a list of "
+                             "dicts")
+        now = time.time()
+        n_delta = sum(1 for c in contributors if c.get("delta"))
+        fresh: List[tuple] = []         # (contrib, job) accepted so far
+        malformed: List[tuple] = []     # (contrib, job, why)
+        outcomes: Dict = {}
+        for c in contributors:
+            jid = c.get("job_id")
+            entry = self._inflight.pop(jid, None)
+            if entry is None:
+                self._m["stale_updates"].inc()
+                outcomes[jid] = "stale"
+                continue
+            job, t_issued, _ = entry
+            self._durations.append(now - t_issued)
+            cid = str(c.get("id", sid))
+            if c.get("refused"):
+                self._refuse_update(
+                    job, cid, f"delta quarantined at relay {sid!r}: "
+                              f"{c['refused']}",
+                    counter="quarantined_updates", quarantined=True)
+                outcomes[jid] = "quarantined"
+                continue
+            metrics = c.get("metrics")
+            why = None
+            if "minibatches" in job:
+                ms = metrics or []
+                if not isinstance(ms, (list, tuple)) \
+                        or len(ms) != len(job["minibatches"]) \
+                        or not all(m is None or isinstance(m, dict)
+                                   for m in ms):
+                    n = len(ms) if hasattr(ms, "__len__") else type(ms)
+                    why = (f"segment metrics length {n!r} != "
+                           f"{len(job['minibatches'])}")
+            elif not (metrics is None or isinstance(metrics, dict)):
+                why = ("metrics payload is "
+                       f"{type(metrics).__name__}, not a dict")
+            if why is not None:
+                malformed.append((c, job, why))
+                outcomes[jid] = "refused"
+                continue
+            fresh.append((c, job))
+        deltas = req.get("deltas")
+        if malformed and deltas and any(c.get("delta")
+                                        for c, _, _ in malformed):
+            # a delta-bearing contributor with a malformed reply: its
+            # gradient is baked into the INDIVISIBLE sum, and the
+            # star's order is refuse-BEFORE-apply — so the whole
+            # aggregate is refused: the malformed children take the
+            # bounded bad-reply policy, their innocent siblings come
+            # back via the reaper's counter with no strike
+            for c, job, why in malformed:
+                self._refuse_update(job, str(c.get("id", sid)), why)
+            for c, job in fresh:
+                self._pending.append(job)
+                self._m["jobs_requeued"].inc()
+                outcomes[c.get("job_id")] = "requeued"
+            return {"ok": False, "outcomes": outcomes,
+                    "error": "aggregate refused: " + "; ".join(
+                        w for _, _, w in malformed)}
+        for c, job, why in malformed:
+            # delta-less malformed replies (eval metrics) refuse
+            # per-child exactly like the star — nothing of theirs is
+            # in the sum
+            self._refuse_update(job, str(c.get("id", sid)), why)
+        # the apply is gated on a FRESH delta-bearing contributor: a
+        # relay re-sends the same flush bytes after a lost reply (the
+        # client's resend discipline), and on the second delivery every
+        # contributor pops as stale — the sum must then be DROPPED like
+        # a stale star update, or the gradient lands twice
+        if deltas and any(c.get("delta") for c, _ in fresh):
+            reason = self._quarantine_reason(deltas,
+                                             n_contrib=max(1, n_delta))
+            if reason:
+                for c, job in fresh:
+                    self._refuse_update(
+                        job, str(c.get("id", sid)),
+                        f"aggregated delta quarantined: {reason}",
+                        counter="quarantined_updates", quarantined=True)
+                return {"ok": False, "quarantined": True,
+                        "error": f"delta quarantined: {reason}",
+                        "outcomes": outcomes}
+            self.apply_deltas(deltas)
+        for c, job in fresh:
+            # async arrivals after completion must not rewind decision
+            # state (same guard as the star path)
+            if not bool(self.decision.complete):
+                if "minibatches" in job:
+                    for mb, m in zip(job["minibatches"],
+                                     c.get("metrics") or []):
+                        self._feed_decision(mb, m or {})
+                else:
+                    self._feed_decision(job, c.get("metrics") or {})
+            cid = str(c.get("id", sid))
+            self._m["jobs_done"].inc()
+            self.jobs_by_slave[cid] = self.jobs_by_slave.get(cid, 0) + 1
+            outcomes[c.get("job_id")] = "ok"
+        self._m["aggregated_updates"].inc()
+        return {"ok": True, "complete": bool(self.decision.complete),
+                "outcomes": outcomes}
 
 
 # historical counter attributes, generated from COUNTERS (name + HELP

@@ -43,6 +43,7 @@ class WebStatus:
         self.port = int(port)
         self.workflows: List[object] = []
         self.server = None                  # optional master (topology)
+        self.relays: List[object] = []      # optional relay nodes (tree)
         self.inference = None               # optional inference service
         self.inference_client = None        # optional breaker-side view
         self._server: Optional[ThreadingHTTPServer] = None
@@ -55,6 +56,13 @@ class WebStatus:
     def register_server(self, server) -> None:
         """Show the master/slave topology (reference dashboard feature)."""
         self.server = server
+
+    def register_relay(self, relay) -> None:
+        """Show an aggregation-tree relay node (ISSUE 10): its children,
+        upstream, queue/flush state and byte/refusal accounting — the
+        tree-topology panel.  Register each co-located relay."""
+        if relay not in self.relays:
+            self.relays.append(relay)
 
     def register_inference(self, server) -> None:
         """Show the inference service's serving panel (ISSUE 4): qps,
@@ -112,6 +120,7 @@ class WebStatus:
             # structures from this HTTP thread could raise mid-request
             live = dict(srv.slaves)
             dead = dict(srv.dead_slaves)
+            jobs_by_slave = dict(srv.jobs_by_slave)
             from znicz_tpu.network_common import PROTOCOL_VERSION
 
             ratio = srv.compression_ratio()
@@ -140,19 +149,35 @@ class WebStatus:
                 "resumed": bool(srv.resumed),
                 "resume_saves": srv.resume_saves,
                 "job_timeout_s": round(srv.effective_job_timeout(), 3),
+                "aggregated_updates": srv.aggregated_updates,
                 "slaves": [
                     {"id": sid,
-                     "jobs": srv.jobs_by_slave.get(sid, 0),
-                     "last_seen_s": round(now - seen, 1)}
+                     "jobs": jobs_by_slave.get(sid, 0),
+                     "last_seen_s": round(now - seen, 1),
+                     # tree topology (ISSUE 10): direct children that
+                     # are relays, not leaf slaves
+                     "relay": sid in srv.relays}
                     for sid, seen in sorted(live.items())],
+                # leaf slaves working BEHIND relays: attributed in
+                # jobs_by_slave (contributor manifests) but never
+                # direct members (iterated from the copy above — the
+                # serve thread mutates the live dict concurrently)
+                "leaves": [
+                    {"id": sid, "jobs": n}
+                    for sid, n in sorted(jobs_by_slave.items())
+                    if sid not in live and sid not in dead],
                 # evicted-but-remembered membership (their job history
                 # survives for the final report)
                 "dead_slaves": [
                     {"id": sid,
-                     "jobs": srv.jobs_by_slave.get(sid, 0),
+                     "jobs": jobs_by_slave.get(sid, 0),
                      "last_seen_s": round(now - seen, 1)}
                     for sid, seen in sorted(dead.items())],
             }
+        if self.relays:
+            # each stats() assembles under the relay's own lock — safe
+            # from this HTTP thread while the relays serve
+            out["relays"] = [r.stats() for r in self.relays]
         if self.inference is not None:
             # stats() assembles from plain counters — safe to call from
             # this HTTP thread while the service runs
@@ -259,8 +284,9 @@ class WebStatus:
                     master = snap.get("master")
                     if master:
                         srows = "".join(
-                            f"<tr><td>{html.escape(s['id'])}</td>"
-                            f"<td>{s['jobs']}</td>"
+                            f"<tr><td>{html.escape(s['id'])}"
+                            f"{' (relay)' if s.get('relay') else ''}"
+                            f"</td><td>{s['jobs']}</td>"
                             f"<td>{s['last_seen_s']}s ago</td></tr>"
                             for s in master["slaves"])
                         master_html = (
@@ -284,7 +310,37 @@ class WebStatus:
                             "<table border=1><tr><th>slave</th><th>jobs"
                             f"</th><th>last seen</th></tr>{srows}</table>"
                             f"<p>dead slaves: {len(master['dead_slaves'])}"
-                            "</p>")
+                            f", aggregated updates: "
+                            f"{master.get('aggregated_updates', 0)}, "
+                            "leaves behind relays: "
+                            f"{len(master.get('leaves', []))}</p>")
+                    relays_html = ""
+                    for r in snap.get("relays", []):
+                        # the tree-topology panel (ISSUE 10): one box
+                        # per co-located relay, children indented under
+                        # their upstream edge
+                        crows = "".join(
+                            f"<tr><td>{html.escape(c['id'])}</td>"
+                            f"<td>{c['last_seen_s']}s ago</td></tr>"
+                            for c in r["children"])
+                        relays_html += (
+                            f"<h2>Relay {html.escape(r['id'])}</h2>"
+                            f"<p>{html.escape(r['bind'])} &rarr; "
+                            f"upstream {html.escape(r['upstream'])}, "
+                            f"fanout {r['fanout']}, wire "
+                            f"{r['wire_dtype']}"
+                            f"{', DONE' if r['complete'] else ''}</p>"
+                            f"<p>flushes: {r['flushes']}, contributions: "
+                            f"{r['contributions']}, refusals: "
+                            f"{r['refusals']}, jobs served: "
+                            f"{r['jobs_served']}, queue: "
+                            f"{r['queue_depth']}, buffered: "
+                            f"{r['buffered_contributions']}, bytes "
+                            f"{r['bytes_in']} in / {r['bytes_out']} out, "
+                            f"bad frames: {r['bad_frames']}, upstream "
+                            f"reconnects: {r['upstream_reconnects']}</p>"
+                            "<table border=1><tr><th>child</th>"
+                            f"<th>last seen</th></tr>{crows}</table>")
                     serving_html = ""
                     serving = snap.get("serving")
                     if serving:
@@ -359,7 +415,7 @@ class WebStatus:
                         "<h2>Workflows</h2><table border=1>"
                         "<tr><th>name</th><th>epoch</th><th>best</th>"
                         f"<th>state</th></tr>{rows}</table>"
-                        f"{master_html}{serving_html}"
+                        f"{master_html}{relays_html}{serving_html}"
                         "<p><a href='/metrics'>/metrics</a> "
                         "<a href='/trace.json'>/trace.json</a> "
                         "<a href='/status.json'>/status.json</a> "
