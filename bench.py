@@ -869,6 +869,26 @@ AGG_CONV_BAND = 25.0        # |star - tree| err_pct tolerance (async
 AGG_ERR_CEIL = 70.0
 AGG_BASE_PORT = 18600
 
+#: --agg phase 3 (ISSUE 11): the ELASTIC scenario — the same 8-slave
+#: fanout-2 tree with quorum + bounded/weighted staleness on, run once
+#: fault-free and once with a seeded SubtreePreempter killing mid-relay
+#: 0's WHOLE subtree (1 mid + 2 leaf relays + 4 slaves = half the
+#: fleet, >= the 1/3 the acceptance demands) mid-run and restarting it
+#: ~5 s later.  Gates: the preempted run lands inside the fault-free
+#: band, apply progress CONTINUES during the kill window, and the job
+#: ledger balances (jobs_done + requeues + refusals == dispatched — no
+#: gradient lost or double-applied across the re-plan).  The denser
+#: job stream needs a calmer lr: at the sample default 0.1, 8 fully-
+#: async replicas over 20 minibatches/epoch diverge with or without
+#: the elastic knobs.
+ELASTIC_MIN_SLAVES = 3
+ELASTIC_STALENESS_BOUND = 50
+ELASTIC_LR = 0.03
+ELASTIC_EPOCHS = 5
+ELASTIC_N_TRAIN = 1200
+ELASTIC_SEED = 23
+ELASTIC_BAND = 25.0
+
 
 def _agg_make_workflow(tag: str, max_epochs: int = 3,
                        n_train: int = 300):
@@ -1012,6 +1032,158 @@ def _agg_real_fleet(endpoints, master_endpoint, tag):
     return server, float(dec.epoch_metrics[1]["err_pct"])
 
 
+def _agg_elastic_run(tag, port, preempt: bool):
+    """One elastic 8-slave fanout-2 tree run (ISSUE 11): quorum +
+    bounded/weighted staleness on; with ``preempt``, a seeded
+    :class:`SubtreePreempter` kills mid-relay 0's whole subtree
+    mid-run and restarts it.  Returns ``(server, err_pct, marks)`` —
+    ``marks`` holds the counter snapshots taken at kill and restart,
+    the degraded-window progress evidence."""
+    import threading
+
+    from znicz_tpu.client import Client
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.chaos import (FaultSchedule, RelayHarness,
+                                          SubtreePreempter)
+    from znicz_tpu.parallel.relay import plan_tree
+    from znicz_tpu.server import Server
+
+    master_ep = f"tcp://127.0.0.1:{port}"
+    plan = plan_tree(AGG_SLAVES, AGG_FANOUT, master_ep,
+                     base_port=port + 1)
+    from znicz_tpu.samples import mnist  # noqa: F401 -- the import
+    # applies the sample's config DEFAULTS; reading prev_lr before it
+    # would capture None and the restore below would poison the tree
+    prev_lr = root.mnist.get("learning_rate")
+    root.mnist.learning_rate = ELASTIC_LR
+    preempter = None
+    harnesses = []
+    try:
+        wf = _agg_make_workflow(f"{tag}_m", max_epochs=ELASTIC_EPOCHS,
+                                n_train=ELASTIC_N_TRAIN)
+        # job_timeout is the reap CEILING and must sit well inside the
+        # down window: the epoch tail waits on the dead subtree's
+        # in-flight jobs, and only the reaper frees it
+        server = Server(wf, endpoint=master_ep, job_timeout=2.5,
+                        slave_ttl=1.5, min_slaves=ELASTIC_MIN_SLAVES,
+                        staleness_bound=ELASTIC_STALENESS_BOUND,
+                        staleness_weight=True)
+        harnesses = [RelayHarness(r["upstream"], r["bind"],
+                                  relay_id=f"{tag}-r{i}",
+                                  recv_timeout=1.0, max_reconnects=60,
+                                  child_ttl=1.5)
+                     for i, r in enumerate(plan["relays"])]
+        for h in harnesses:
+            h.start()
+        wfs = [_agg_make_workflow(f"{tag}_s{i}",
+                                  max_epochs=ELASTIC_EPOCHS,
+                                  n_train=ELASTIC_N_TRAIN)
+               for i in range(AGG_SLAVES)]
+        clients = [Client(wfs[i], endpoint=plan["slave_endpoints"][i],
+                          slave_id=f"{tag}w{i}")
+                   for i in range(AGG_SLAVES)]
+        errors, threads = [], {}
+
+        def start_slave(i):
+            def worker(c):
+                try:
+                    c.run(recv_timeout=1.0, max_reconnects=80,
+                          backoff_base=0.05, backoff_cap=0.4,
+                          connect_retries=80)
+                except BaseException as e:
+                    errors.append((c.slave_id, repr(e)))
+                    raise
+            t = threading.Thread(target=worker, args=(clients[i],),
+                                 daemon=True)
+            threads[i] = t
+            t.start()
+
+        for i in range(AGG_SLAVES):
+            start_slave(i)
+        marks = {}
+        server_thread = threading.Thread(
+            target=server.serve, kwargs={"linger": 6.0}, daemon=True)
+        server_thread.start()
+        if preempt:
+            mid_bind = plan["relays"][0]["bind"]
+            sub_relays = [0] + [j for j, r in enumerate(plan["relays"])
+                                if r["upstream"] == mid_bind]
+            sub_binds = {plan["relays"][j]["bind"] for j in sub_relays}
+            sub_slaves = [i for i, ep
+                          in enumerate(plan["slave_endpoints"])
+                          if ep in sub_binds]
+
+            def snap():
+                return {"jobs_done": int(server.jobs_done),
+                        "aggregated": int(server.aggregated_updates),
+                        "weighted": int(server.weighted_applies),
+                        "members": int(server.member_count())}
+
+            def kill():
+                for i in sub_slaves:
+                    clients[i].preempt()
+                for i in sub_slaves:
+                    threads[i].join(timeout=10)
+                for j in sub_relays:
+                    harnesses[j].kill(timeout=10)
+                marks["kill"] = snap()
+
+            def restart():
+                marks["restart"] = snap()
+                for j in sub_relays:
+                    harnesses[j].start()
+                for i in sub_slaves:
+                    clients[i] = Client(
+                        wfs[i], endpoint=plan["slave_endpoints"][i],
+                        slave_id=f"{tag}w{i}")
+                    start_slave(i)
+
+            marks["preempted"] = {"relays": len(sub_relays),
+                                  "slaves": len(sub_slaves)}
+            preempter = SubtreePreempter(
+                FaultSchedule(ELASTIC_SEED),
+                [("mid0-subtree", kill, restart)],
+                kill_s=(0.2, 0.6), down_s=(4.5, 5.5))
+            deadline = time.time() + 180
+            while server.jobs_done < 12 and time.time() < deadline \
+                    and server_thread.is_alive():
+                time.sleep(0.05)
+            if server.jobs_done < 12 or not server_thread.is_alive():
+                # a dead/stalled warm-up must fail AS a warm-up
+                # failure, not fire the kill anyway and trip the
+                # progress gate with a misleading message
+                raise SystemExit(
+                    f"{tag}: warm-up failed before the preemption "
+                    f"(jobs_done={server.jobs_done}, master alive="
+                    f"{server_thread.is_alive()}) — enlarge the "
+                    "workload or the deadline")
+            preempter.start()       # seeded timetable, anchored mid-run
+        server_thread.join(timeout=600)
+        if server_thread.is_alive():
+            raise SystemExit(f"{tag}: master hung")
+        if preempter is not None and not preempter.join(60):
+            raise SystemExit(f"{tag}: preempter hung")
+        for t in threads.values():
+            t.join(timeout=60)
+        if errors:
+            raise SystemExit(f"{tag}: slaves crashed: {errors}")
+        if any(t.is_alive() for t in threads.values()):
+            raise SystemExit(f"{tag}: slaves hung")
+        dec = wf.decision
+        if not bool(dec.complete):
+            raise SystemExit(f"{tag}: training did not complete")
+        return server, float(dec.epoch_metrics[1]["err_pct"]), marks
+    finally:
+        root.mnist.learning_rate = prev_lr
+        if preempter is not None:
+            preempter.stop()
+        for h in harnesses:
+            try:
+                h.kill(timeout=5)
+            except Exception:
+                pass
+
+
 def agg_main() -> None:
     """``--agg``: the relay-tree aggregation gate (ISSUE 10).  One JSON
     line with the star-vs-tree byte/decode ratios and the convergence
@@ -1066,6 +1238,12 @@ def agg_main() -> None:
         for r in relays:
             r.stop()
 
+    # -- phase 3: the elastic scenario (ISSUE 11) ------------------------------
+    srv_ff, err_ff, _ = _agg_elastic_run("eff", port + 40, preempt=False)
+    srv_pre, err_pre, marks = _agg_elastic_run("epre", port + 60,
+                                               preempt=True)
+    ledger = srv_pre.jobs_ledger()
+
     print(json.dumps({
         "metric": "agg_bytes_into_master_ratio",
         "value": round(bytes_ratio, 4),
@@ -1087,6 +1265,20 @@ def agg_main() -> None:
                             srv_tree.aggregated_updates,
                         "star_aggregated":
                             srv_star.aggregated_updates},
+        "elastic": {
+            "fault_free_err_pct": err_ff,
+            "preempted_err_pct": err_pre,
+            "min_slaves": ELASTIC_MIN_SLAVES,
+            "staleness_bound": ELASTIC_STALENESS_BOUND,
+            "preempted": marks.get("preempted"),
+            "kill": marks.get("kill"), "restart": marks.get("restart"),
+            "stale_refused": srv_pre.stale_refused,
+            "weighted_applies": srv_pre.weighted_applies,
+            "replans": srv_pre.replans,
+            "preemptions_ridden": srv_pre.preemptions_ridden,
+            "reregistrations": srv_pre.reregistrations,
+            "ledger": ledger,
+        },
     }))
     # gates AFTER the JSON line (ISSUE 10 acceptance)
     if bytes_ratio > AGG_RATIO_CEIL:
@@ -1111,6 +1303,40 @@ def agg_main() -> None:
     if srv_tree.aggregated_updates <= 0 or tree.aggregated_updates <= 0:
         raise SystemExit("tree runs produced no aggregated updates — "
                          "the relays were not in the path")
+    # -- elastic gates (ISSUE 11 acceptance) -----------------------------------
+    if err_pre >= AGG_ERR_CEIL or err_ff >= AGG_ERR_CEIL:
+        raise SystemExit(
+            f"elastic convergence left the band: fault-free {err_ff}%, "
+            f"preempted {err_pre}% (ceiling {AGG_ERR_CEIL}%)")
+    if abs(err_pre - err_ff) >= ELASTIC_BAND:
+        raise SystemExit(
+            f"preempted run left the fault-free band: "
+            f"|{err_pre} - {err_ff}| >= {ELASTIC_BAND}")
+    k, r = marks.get("kill"), marks.get("restart")
+    if not k or not r:
+        raise SystemExit("the preemption never executed — no kill/"
+                         "restart marks recorded")
+    if r["jobs_done"] <= k["jobs_done"]:
+        raise SystemExit(
+            f"no apply progress during the kill window: jobs_done "
+            f"{k['jobs_done']} -> {r['jobs_done']}")
+    if r["aggregated"] <= k["aggregated"] and \
+            r["weighted"] <= k["weighted"]:
+        raise SystemExit(
+            "no aggregated/weighted applies during the kill window: "
+            f"{k} -> {r}")
+    if not ledger["balanced"]:
+        raise SystemExit(
+            f"job ledger does not balance after the re-plan — a job "
+            f"was lost or double-counted: {ledger}")
+    if srv_pre.preemptions_ridden < 1 or srv_pre.replans < 1:
+        raise SystemExit(
+            "the elastic machinery never engaged: preemptions_ridden="
+            f"{srv_pre.preemptions_ridden}, replans={srv_pre.replans}")
+    if srv_pre.weighted_applies <= 0:
+        raise SystemExit("no staleness-weighted applies in a fully-"
+                         "async 8-slave run — the stamps are not "
+                         "flowing")
 
 
 #: --serve protocol knobs (ISSUE 4).  All gates are RELATIVE to numbers

@@ -233,6 +233,17 @@ class Client:
         #: relay death costs a backoff window, not the slave.  The
         #: master advertises none, so the star behavior is unchanged.
         self._fallback_endpoint: Optional[str] = None
+        #: simulated spot preemption (ISSUE 11): chaos drivers set this
+        #: to make run() exit at its next loop top WITHOUT sending the
+        #: pending update or finishing the in-flight job — exactly what
+        #: a killed instance loses
+        self._preempt = threading.Event()
+
+    def preempt(self) -> None:
+        """Kill switch for the preemption chaos harness: the slave
+        vanishes mid-whatever at its next loop iteration; the master's
+        reaper recovers its in-flight job."""
+        self._preempt.set()
 
     def _rpc(self, sock, msg: dict) -> dict:
         from znicz_tpu.parallel import wire
@@ -471,6 +482,8 @@ class Client:
 
         try:
             while True:
+                if self._preempt.is_set():
+                    break               # simulated spot kill (ISSUE 11)
                 if not registered:
                     try:
                         rep = self._rpc(sock,
@@ -493,6 +506,26 @@ class Client:
                     # failover; the master advertises none
                     self._fallback_endpoint = rep.get("upstream")
                     registered = ever_registered = True
+                    rehome = rep.get("rehome")
+                    if rehome and rehome != self.endpoint:
+                        # the master re-homed this orphan leaf behind a
+                        # live relay (ISSUE 11 tree healing).  Keep the
+                        # CURRENT endpoint as the fallback, so a rehome
+                        # target that died in the meantime costs one
+                        # more backoff window, never the slave.
+                        log.info("%s: master re-homed us to %s",
+                                 self.slave_id, rehome)
+                        self._fallback_endpoint = self.endpoint
+                        self.endpoint = rehome
+                        registered = False
+                        sock.close(0)
+                        sock = self._connect(ctx, timeout_ms)
+                        if prefetcher is not None:
+                            # its socket still points at the OLD peer —
+                            # retire it; re-created lazily on the next
+                            # real job
+                            prefetcher.stop()
+                            prefetcher = None
                     continue
                 if update_frames is not None:
                     try:
@@ -513,6 +546,12 @@ class Client:
                     if rep.get("quarantined"):
                         log.warning("%s: master quarantined our delta: %s",
                                     self.slave_id, rep.get("error"))
+                    if rep.get("stale_refused"):
+                        # bounded staleness (ISSUE 11): the job was
+                        # re-queued master-side; we just move on
+                        log.info("%s: master refused our delta as "
+                                 "stale: %s", self.slave_id,
+                                 rep.get("error"))
                     update_frames = None
                     self._m["jobs_done"].inc()
                     continue
@@ -569,6 +608,9 @@ class Client:
                     {"cmd": "update", "id": self.slave_id,
                      "job_id": rep["job_id"],
                      "trace_id": rep.get("trace_id"),
+                     # the apply-counter stamp echoed back (ISSUE 11):
+                     # the master reads the delta's staleness off it
+                     "step": rep.get("step"),
                      "deltas": self._delta_encoder.encode(deltas),
                      "metrics": metrics})
         finally:

@@ -230,4 +230,14 @@ ENGINE_DEFAULTS = {
     # relay-tree aggregation (ISSUE 10)
     "tree_fanout": 2,             # children per relay; job-batch factor
     "relay_flush_s": 0.05,        # max buffered-contribution age
+    "relay_child_ttl": 30.0,      # relay-tier child eviction window (a
+    #                               tree wants a SHORTER leaf TTL than
+    #                               the master's relay TTL: slave_ttl)
+    # elastic async training (ISSUE 11)
+    "min_slaves": 0,              # quorum gate; 0 = no gate
+    "staleness_bound": 0,         # refuse deltas staler than this many
+    #                               applies (re-queued); 0 = unbounded
+    "staleness_weight": False,    # scale applies by 1/(1+staleness)
+    "elastic_rehome": False,      # master redirects orphan leaves that
+    #                               register directly to a live relay
 }

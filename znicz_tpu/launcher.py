@@ -106,6 +106,19 @@ class Launcher:
                                  "(root.common.engine.tree_fanout, "
                                  "default 2): the flush threshold and "
                                  "job-batch amplification factor")
+        parser.add_argument("--min-slaves", type=int, default=None,
+                            metavar="N",
+                            help="elastic quorum gate for the master "
+                                 "role (root.common.engine.min_slaves): "
+                                 "below N live members (direct slaves + "
+                                 "leaves reported by relays) dispatch "
+                                 "pauses and readiness reports degraded")
+        parser.add_argument("--staleness-bound", type=int, default=None,
+                            metavar="S",
+                            help="bounded-staleness apply "
+                                 "(root.common.engine.staleness_bound): "
+                                 "refuse-and-requeue deltas staler than "
+                                 "S applies; 0 = unbounded")
         parser.add_argument("--plan-tree", type=int, default=None,
                             metavar="N_SLAVES",
                             help="print the relay-tree plan (tiers, "
@@ -140,6 +153,10 @@ class Launcher:
         args = self.args
         if args.tree_fanout is not None:
             root.common.engine.tree_fanout = int(args.tree_fanout)
+        if args.min_slaves is not None:
+            root.common.engine.min_slaves = int(args.min_slaves)
+        if args.staleness_bound is not None:
+            root.common.engine.staleness_bound = int(args.staleness_bound)
         if args.plan_tree is not None:
             return self._plan_tree(args)
         if args.relay is not None:

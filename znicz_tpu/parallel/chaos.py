@@ -65,6 +65,11 @@ class FaultSchedule:
     #: must leave its WIRE decisions byte-identical
     COMPUTE_SALT = 0x57A11
 
+    #: salt for the subtree-preemption timetable stream (ISSUE 11) —
+    #: same independence contract: adding preemptions to a schedule
+    #: leaves its wire and compute decisions byte-identical
+    PREEMPT_SALT = 0x5B07
+
     def __init__(self, seed: int, drop: float = 0.0, corrupt: float = 0.0,
                  duplicate: float = 0.0, delay: float = 0.0,
                  delay_s: Tuple[float, float] = (0.05, 0.2),
@@ -125,6 +130,22 @@ class FaultSchedule:
             lo, hi = self.stall_s
             return "stall", lo + float(rng.random()) * (hi - lo)
         return "run", 0.0
+
+    def decide_preempt(self, target_no: int,
+                       kill_s: Tuple[float, float] = (0.5, 2.0),
+                       down_s: Tuple[float, float] = (1.0, 3.0)
+                       ) -> Tuple[float, float]:
+        """``(kill_at, down)`` seconds for subtree target ``target_no``
+        (ISSUE 11): when the target is killed, relative to the driver's
+        start, and how long it stays down before restart.  A pure
+        function of ``(seed, target_no)`` on its own salted stream, so
+        a preemption timetable replays identically run to run and never
+        perturbs the wire/compute decisions of the same seed."""
+        rng = np.random.default_rng(
+            (self.seed, int(target_no), self.PREEMPT_SALT))
+        kill_at = kill_s[0] + float(rng.random()) * (kill_s[1] - kill_s[0])
+        down = down_s[0] + float(rng.random()) * (down_s[1] - down_s[0])
+        return float(kill_at), float(down)
 
 
 def corrupt_payload(payload: bytes) -> bytes:
@@ -451,6 +472,94 @@ def _flood_main(argv: List[str]) -> None:  # pragma: no cover - subprocess
 # -- process-level kill harness ------------------------------------------------
 
 
+class SubtreePreempter:
+    """Spot/preempt chaos (ISSUE 11): kill and restart whole relay
+    subtrees on a seeded timetable.
+
+    Each target is ``(name, kill_fn, restart_fn)`` — typically closures
+    over a :class:`RelayHarness` per relay of the subtree plus
+    ``Client.preempt()`` calls for its slaves.  The timetable comes from
+    :meth:`FaultSchedule.decide_preempt` (pure function of (seed,
+    target_no)); ``start()`` runs it on a daemon thread, recording each
+    executed action with its WALL time so a gate can hold progress
+    counters to the exact kill window (``window()``).  All recorded
+    state is lock-guarded: the driver thread writes while the test
+    thread reads mid-run."""
+
+    def __init__(self, schedule: FaultSchedule, targets,
+                 kill_s: Tuple[float, float] = (0.5, 2.0),
+                 down_s: Tuple[float, float] = (1.0, 3.0)):
+        self.schedule = schedule
+        self.targets = list(targets)
+        self.timetable: List[tuple] = []    # (at_s, idx, action, fn, name)
+        for i, (name, kill_fn, restart_fn) in enumerate(self.targets):
+            kill_at, down = schedule.decide_preempt(i, kill_s, down_s)
+            self.timetable.append((kill_at, i, "kill", kill_fn, name))
+            self.timetable.append((kill_at + down, i, "restart",
+                                   restart_fn, name))
+        self.timetable.sort(key=lambda t: (t[0], t[1], t[2]))
+        self._lock = threading.Lock()
+        self._events: List[Tuple[float, str, str]] = []  # (wall, name, act)
+        self._preempted = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def events(self) -> List[Tuple[float, str, str]]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def preemptions(self) -> int:
+        with self._lock:
+            return self._preempted
+
+    def window(self) -> Optional[Tuple[float, float]]:
+        """(first kill wall time, last restart wall time) of everything
+        executed so far — the degraded window a progress gate holds its
+        counters to; None before the first kill."""
+        with self._lock:
+            kills = [t for t, _, a in self._events if a == "kill"]
+            rests = [t for t, _, a in self._events if a == "restart"]
+        if not kills:
+            return None
+        return min(kills), max(rests) if rests else time.time()
+
+    def start(self) -> "SubtreePreempter":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="chaos-preempter")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 120.0) -> bool:
+        """Wait for the whole timetable to execute; True when it did."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        t0 = time.time()
+        for at, _, action, fn, name in self.timetable:
+            while time.time() - t0 < at:
+                if self._stop.wait(min(0.02, max(0.001,
+                                                 at - (time.time() - t0)))):
+                    return
+            if self._stop.is_set():
+                return
+            fn()
+            with self._lock:
+                self._events.append((time.time(), name, action))
+                if action == "kill":
+                    self._preempted += 1
+
+
 class RelayHarness:
     """Kill/restart driver for an aggregation-tree relay (ISSUE 10).
 
@@ -490,41 +599,59 @@ class RelayHarness:
 
 
 def take_job_and_die(endpoint: str, workflow, slave_id: str = "doomed",
-                     timeout_ms: int = 10_000) -> Optional[int]:
+                     timeout_ms: int = 10_000,
+                     attempts: int = 40) -> Optional[int]:
     """The canonical mid-job slave death: register, take ONE job, vanish
     without replying.  Returns the job_id the master now holds in flight
     — it must come back via the reaper (``jobs_requeued``) for the
     no-silent-loss property to hold — or None if training already ended.
-    """
+
+    Rides transport faults like a real slave (fresh socket +
+    re-register on a timeout, a corrupted reply, or a ``bad_frame``
+    refusal of its own corrupted frame, bounded by ``attempts``) — when
+    driven through the ChaosProxy its frames get corrupted like
+    anyone else's, and the doomed slave must still reach its job."""
     import zmq
 
     from znicz_tpu.network_common import handshake_request
     from znicz_tpu.parallel import wire
 
     ctx = zmq.Context.instance()
-    sock = ctx.socket(zmq.REQ)
-    sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
-    sock.setsockopt(zmq.LINGER, 0)
-    sock.connect(endpoint)
+    last: Optional[BaseException] = None
+    for _ in range(attempts):
+        sock = ctx.socket(zmq.REQ)
+        sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(endpoint)
 
-    def rpc(msg: dict) -> dict:
-        frames, _ = wire.encode_message(dict(msg, id=slave_id))
-        sock.send_multipart(frames)
-        return wire.decode_message(sock.recv_multipart())[0]
+        def rpc(msg: dict) -> dict:
+            frames, _ = wire.encode_message(dict(msg, id=slave_id))
+            sock.send_multipart(frames)
+            return wire.decode_message(sock.recv_multipart())[0]
 
-    try:
-        rep = rpc(handshake_request(workflow))
-        if not rep.get("ok"):
-            raise RuntimeError(f"registration refused: {rep.get('error')}")
-        while True:
-            rep = rpc({"cmd": "job"})
-            if "job" in rep:
-                return rep["job_id"]
-            if rep.get("done"):
-                return None
-            time.sleep(0.05)
-    finally:
-        sock.close(0)                   # died mid-job, update never sent
+        try:
+            rep = rpc(handshake_request(workflow))
+            if rep.get("bad_frame"):
+                continue        # our register corrupted in flight: retry
+            if not rep.get("ok"):
+                raise RuntimeError(
+                    f"registration refused: {rep.get('error')}")
+            while True:
+                rep = rpc({"cmd": "job"})
+                if "job" in rep:
+                    return rep["job_id"]
+                if rep.get("done"):
+                    return None
+                if rep.get("unregistered"):
+                    break       # master lost us: fresh cycle, re-register
+                time.sleep(0.05)
+        except (zmq.Again, wire.WireError) as exc:
+            last = exc          # EFSM-broken socket: reconnect fresh
+        finally:
+            sock.close(0)               # died mid-job, update never sent
+    raise RuntimeError(
+        f"doomed slave never reached a job through the chaos "
+        f"({attempts} attempts; last fault: {last!r})")
 
 
 class MasterHarness:

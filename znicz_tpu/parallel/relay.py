@@ -163,6 +163,7 @@ class Relay:
         "relay_contributions": "child update contributions accepted",
         "relay_jobs_served": "jobs served to children",
         "relay_upstream_reconnects": "fresh-socket retries upstream",
+        "relay_rehomes": "upstream re-homes to the advertised fallback",
     }
 
     def __init__(self, upstream: str, bind: str,
@@ -195,9 +196,12 @@ class Relay:
         #: membership hygiene, the master's TTL rule at the relay tier:
         #: a child silent this long leaves the table — a dead sibling
         #: must not inflate the flush threshold (and the dashboard)
-        #: forever; a re-register brings it straight back
+        #: forever; a re-register brings it straight back.  Its OWN
+        #: knob (ISSUE 11 satellite): a tree wants a SHORTER leaf TTL
+        #: than the master's relay TTL (``slave_ttl``) — leaves churn,
+        #: relays should not
         self.child_ttl = float(
-            root.common.engine.get("slave_ttl", 60.0)
+            root.common.engine.get("relay_child_ttl", 30.0)
             if child_ttl is None else child_ttl)
         #: upward re-encoding of the summed delta, with the relay's OWN
         #: error-feedback residuals (re-quantization loses nothing over
@@ -235,6 +239,18 @@ class Relay:
         #: handful of upstream polls, not a stream of them.
         self._wait_until = 0.0
         self._wait_streak = 0
+        #: runtime tree healing (ISSUE 11): the endpoint OUR upstream
+        #: advertised as its own upstream at register time.  When the
+        #: upstream reconnect budget is spent, the relay re-homes there
+        #: (one hop up the tree) and re-registers instead of going
+        #: silent — a dead mid-tier relay costs its subtree one backoff
+        #: window, not the whole subtree's membership.  Lock-guarded:
+        #: mutated from the serve loop, read by stats()/children.
+        self._upstream_fallback: Optional[str] = None
+        #: per-child subtree leaf counts (a slave counts 1; a lower
+        #: relay reports its own sum on each job request) — summed
+        #: upward so the master's quorum sees through the tree
+        self._child_leaves: Dict[str, int] = {}
         self._delta_norms: List[float] = []         # accepted, per-child
         self._uregistered = False
         self._ufails = 0
@@ -283,18 +299,23 @@ class Relay:
             queued = len(self._jobq)
             buffered = len(self._buffer)
             done = self._done
+            upstream = self.upstream    # may move under re-homing
+            leaves = sum(int(self._child_leaves.get(sid, 1))
+                         for sid in self._children)
         return {
             "id": self.relay_id, "bind": self.bind,
-            "upstream": self.upstream, "fanout": self.fanout,
+            "upstream": upstream, "fanout": self.fanout,
             "wire_dtype": self.wire_dtype,
             "children": children, "queue_depth": queued,
             "buffered_contributions": buffered, "complete": done,
+            "leaves": leaves,
             "bytes_in": self.bytes_in, "bytes_out": self.bytes_out,
             "refusals": self.refusals, "flushes": self.flushes,
             "contributions": self.contributions,
             "jobs_served": self.jobs_served,
             "bad_frames": self.bad_frames,
             "upstream_reconnects": self.upstream_reconnects,
+            "rehomes": self.rehomes,
         }
 
     # -- child-side edge validation (the quarantine mirror) --------------------
@@ -370,7 +391,8 @@ class Relay:
             rep = self._upstream_rpc(
                 {"cmd": "register", "id": self.relay_id, "version": v,
                  "workflow_digest": digest, "relay": True,
-                 "fanout": self.fanout}, is_register=True)
+                 "fanout": self.fanout, "bind": self.bind},
+                is_register=True)
             if rep is None:
                 return {"ok": False,
                         "error": "relay upstream unreachable"}
@@ -382,6 +404,10 @@ class Relay:
                     k: rep.get(k)
                     for k in ("version", "class_lengths", "resumed",
                               "epoch")}
+                # the upstream's OWN fallback advertisement: a relay
+                # upstream names its upstream, the master names none —
+                # the rung this relay re-homes to if upstream dies
+                self._upstream_fallback = rep.get("upstream")
             self._uregistered = True
         else:
             # validated subtree: later children are checked locally,
@@ -399,12 +425,28 @@ class Relay:
         with self._lock:
             self._children[sid] = time.time()
             reply = dict(self._cred_reply)
-        reply.update({"ok": True, "upstream": self.upstream})
+            upstream = self.upstream    # may move under re-homing
+        reply.update({"ok": True, "upstream": upstream})
         return reply
+
+    def _live_leaves(self) -> int:
+        """Subtree leaf count: the sum of what each live child last
+        reported (a slave counts 1) — piggybacked on upstream job
+        requests so the master's quorum sees through the tree."""
+        with self._lock:
+            return sum(int(self._child_leaves.get(sid, 1))
+                       for sid in self._children)
 
     def _child_job(self, req: dict, sid: str) -> dict:
         k = max(1, min(int(req.get("count", 1) or 1), 64))
         with self._lock:
+            # a lower relay reports its own subtree size; a slave has
+            # no ``leaves`` key and counts 1
+            try:
+                self._child_leaves[sid] = max(
+                    0, int(req.get("leaves", 1)))
+            except (TypeError, ValueError):
+                self._child_leaves[sid] = 1
             done, have = self._done, len(self._jobq)
             damped = not have and time.time() < self._wait_until
         if done:
@@ -415,6 +457,7 @@ class Relay:
             rep = self._upstream_rpc(
                 {"cmd": "job", "id": self.relay_id,
                  "count": k * self.fanout,
+                 "leaves": self._live_leaves(),
                  "prefetch": bool(req.get("prefetch"))})
             if rep is None:
                 return {"wait": True}       # upstream fault: child re-asks
@@ -431,7 +474,7 @@ class Relay:
             if jobs is None and "job" in rep:
                 jobs = [{key: rep.get(key)
                          for key in ("job_id", "job", "trace_id",
-                                     "train")}]
+                                     "train", "step")}]
             if not jobs:
                 # upstream wait (epoch tail): damp the subtree's polls
                 # so they do not all re-ask the master
@@ -467,6 +510,7 @@ class Relay:
         else:
             entries = [{"id": sid, "job_id": req.get("job_id"),
                         "trace_id": req.get("trace_id"),
+                        "step": req.get("step"),
                         "metrics": req.get("metrics")}]
             n_delta = 1 if deltas else 0
             if deltas:
@@ -558,6 +602,7 @@ class Relay:
             for sid in [s for s, seen in self._children.items()
                         if now - seen > self.child_ttl]:
                 del self._children[sid]
+                self._child_leaves.pop(sid, None)
 
     def _flush_message(self, entries: List[dict],
                        summed: Optional[Dict]) -> dict:
@@ -652,7 +697,8 @@ class Relay:
                     reg, _ = wire.encode_message(
                         {"cmd": "register", "id": self.relay_id,
                          "version": cred[0], "workflow_digest": cred[1],
-                         "relay": True, "fanout": self.fanout})
+                         "relay": True, "fanout": self.fanout,
+                         "bind": self.bind})
                     rep = self._exchange(reg)
                     if rep.get("bad_frame"):
                         if self._count_refusal():
@@ -666,6 +712,10 @@ class Relay:
                             self.relay_id, rep.get("error"))
                         self._stop.set()
                         return None
+                    with self._lock:
+                        # the (possibly NEW, post-re-homing) upstream's
+                        # own fallback advertisement
+                        self._upstream_fallback = rep.get("upstream")
                     self._uregistered = True
                 rep = self._exchange(frames)
                 self._ufails = 0
@@ -692,6 +742,29 @@ class Relay:
                 if self._ufails > self.max_reconnects:
                     import logging
 
+                    with self._lock:
+                        fallback = self._upstream_fallback
+                        if fallback and fallback != self.upstream:
+                            # runtime tree healing (ISSUE 11): re-home
+                            # one rung up the tree instead of going
+                            # silent — this relay's whole subtree keeps
+                            # its membership through a dead mid relay.
+                            # One hop per spent budget; the re-register
+                            # at the new upstream records ITS
+                            # advertisement for the next failure.
+                            self.upstream = fallback
+                            self._upstream_fallback = None
+                        else:
+                            fallback = None
+                    if fallback:
+                        self._m["relay_rehomes"].inc()
+                        self._ufails = 0
+                        logging.getLogger("znicz").warning(
+                            "%s: upstream gone after %d retries — "
+                            "re-homing to its advertised upstream %s",
+                            self.relay_id, self.max_reconnects,
+                            fallback)
+                        continue
                     logging.getLogger("znicz").warning(
                         "%s: upstream %s gone for good after %d retries "
                         "(%r) — relay going silent so children fall "

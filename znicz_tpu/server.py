@@ -76,7 +76,9 @@ class Server:
     def __init__(self, workflow, endpoint: str = "tcp://127.0.0.1:5570",
                  job_timeout: float = 30.0, segment_steps: int = None,
                  resume_path: str = "", snapshot_every_s: float = None,
-                 slave_ttl: float = None):
+                 slave_ttl: float = None, min_slaves: int = None,
+                 staleness_bound: int = None, staleness_weight: bool = None,
+                 elastic_rehome: bool = None):
         from znicz_tpu.core.config import root
 
         self.workflow = workflow
@@ -156,6 +158,57 @@ class Server:
         self.quarantine_norm_mult = float(
             root.common.engine.get("quarantine_norm_mult", 25.0))
         self._param_shapes = None       # lazy {layer: {param: shape}}
+        # -- elastic async training (ISSUE 11) --------------------------
+        #: quorum gate: below this many live members (direct leaf slaves
+        #: + the subtree leaf counts live relays report on their job
+        #: requests) dispatch pauses (job requests get ``wait``) and the
+        #: dashboard/readiness report degraded.  0 disables the gate.
+        self.min_slaves = int(
+            root.common.engine.get("min_slaves", 0)
+            if min_slaves is None else min_slaves)
+        #: bounded staleness: a delta whose job's params stamp is more
+        #: than this many applies behind the current apply counter is
+        #: refused and its job re-queued (``stale_refused``) — a
+        #: straggler's gradient from the distant past must not land on
+        #: params it has never seen.  0 = unbounded (accept anything).
+        self.staleness_bound = int(
+            root.common.engine.get("staleness_bound", 0)
+            if staleness_bound is None else staleness_bound)
+        #: staleness-weighted apply: scale a delta by 1/(1+s) before it
+        #: lands, so a thousand-slave pod rides through stragglers
+        #: instead of letting their stale gradients fight fresh ones at
+        #: full weight.  Fresh deltas (s == 0) are untouched.
+        self.staleness_weight = bool(
+            root.common.engine.get("staleness_weight", False)
+            if staleness_weight is None else staleness_weight)
+        #: runtime tree healing: when on, a LEAF slave registering
+        #: directly at the master while live relays exist is handed a
+        #: ``rehome`` endpoint (a recently-seen relay) in its register
+        #: reply — an orphan that fell back after its relay died is
+        #: steered back under the tree instead of staying a star child
+        self.elastic_rehome = bool(
+            root.common.engine.get("elastic_rehome", False)
+            if elastic_rehome is None else elastic_rehome)
+        #: the apply counter — the staleness clock: one tick per
+        #: accepted delta apply (job replies are stamped with it; the
+        #: slave echoes the stamp back with its update)
+        self._apply_step = 0
+        #: per-relay subtree leaf counts, reported by relays on their
+        #: job requests (``leaves``) — the quorum's view through trees
+        self._relay_leaves: Dict[str, int] = {}
+        #: relay id -> the bind it serves children at (from its register
+        #: message) — the re-planner's and rehome's address book
+        self.relay_binds: Dict[str, str] = {}
+        self._tree_plan: Optional[dict] = None
+        self._rehome_rr = 0
+        #: per-leaf staleness histograms (telemetry family
+        #: ``update_staleness`` labeled by leaf), created lazily
+        self._stale_hist: Dict[str, object] = {}
+        from znicz_tpu.telemetry.metrics import weak_fn
+        _sc.gauge("quorum_members", "live training members (quorum view)",
+                  fn=weak_fn(self, lambda s: s.member_count()))
+        _sc.gauge("quorum_degraded", "1 while below the min_slaves gate",
+                  fn=weak_fn(self, lambda s: 1.0 if s.degraded() else 0.0))
         # -- LR schedules under master/slave (ISSUE 10 satellite): the
         # master owns the train-iteration clock.  Any LearningRateAdjust
         # unit's policy bindings are evaluated HERE at dispatch and the
@@ -192,7 +245,11 @@ class Server:
         return {f.name: f.generate_data_for_slave()
                 for f in self._trainables()}
 
-    def apply_deltas(self, deltas: Dict) -> None:
+    def apply_deltas(self, deltas: Dict, scale: float = 1.0) -> None:
+        """Land a delta set on the global params and advance the apply
+        counter (the staleness clock).  ``scale`` < 1 is the
+        staleness-weighted apply (ISSUE 11): a late gradient still
+        contributes direction, at discounted magnitude."""
         for f in self._trainables():
             d = deltas.get(f.name)
             if not d:
@@ -200,7 +257,12 @@ class Server:
             for k, arr in f.params().items():
                 if k in d:
                     mem = arr.map_write()
-                    mem += d[k]
+                    if scale == 1.0:
+                        mem += d[k]
+                    else:
+                        mem += np.asarray(d[k], mem.dtype) * mem.dtype.type(
+                            scale)
+        self._apply_step += 1
 
     # -- counters (one home: the telemetry registry) ---------------------------
 
@@ -218,6 +280,11 @@ class Server:
         "update_bytes_in": "wire bytes of update messages",
         "prefetch_hit": "jobs served to prefetch requests",
         "aggregated_updates": "pre-aggregated relay updates accepted",
+        # elastic async training (ISSUE 11)
+        "stale_refused": "deltas refused: staleness beyond the bound",
+        "weighted_applies": "applies scaled down by staleness",
+        "replans": "runtime tree re-plans (relay membership changes)",
+        "preemptions_ridden": "members lost mid-run and ridden out",
     }
 
     # (the historical attribute properties are generated from COUNTERS
@@ -299,6 +366,16 @@ class Server:
 
             self.dead_slaves[sid] = self.slaves.pop(sid)
             self.registered.discard(sid)
+            if not bool(self.decision.complete):
+                # a member lost while training continues: a preemption
+                # the elastic mode rode out (ISSUE 11)
+                self._m["preemptions_ridden"].inc()
+            if sid in self.relays:
+                # a relay eviction changes the TREE, not just the
+                # membership: re-plan so rehome targets and the
+                # topology view drop the dead subtree immediately
+                self._relay_leaves.pop(sid, None)
+                self._replan(f"relay {sid} evicted")
             logging.getLogger("znicz").info(
                 "slave %s evicted (silent for %.0fs)", sid, self.slave_ttl)
 
@@ -346,6 +423,177 @@ class Server:
                         f"x median {med:.3g}")
         self._delta_norms.append(norm)
         return None
+
+    # -- elastic async training (ISSUE 11) -------------------------------------
+
+    @property
+    def apply_step(self) -> int:
+        """The apply counter — the staleness clock job stamps count in."""
+        return self._apply_step
+
+    def _staleness(self, step, sid: str) -> int:
+        """Applies elapsed since the job's params stamp (0 for an old
+        peer that echoes no stamp), observed into the per-leaf
+        ``update_staleness`` histogram family.  NEVER raises: it runs
+        AFTER the job left ``_inflight``, so a garbage stamp from a
+        broken peer must degrade to "fresh", not lose the job."""
+        if step is None:
+            return 0
+        try:
+            s = max(0, self._apply_step - int(step))
+        except (TypeError, ValueError):
+            return 0
+        hist = self._stale_hist.get(sid)
+        if hist is None:
+            from znicz_tpu import telemetry
+
+            hist = telemetry.scope("master").histogram(
+                "update_staleness",
+                "delta staleness in applies, at arrival", size=256,
+                leaf=str(sid))
+            self._stale_hist[sid] = hist
+        hist.observe(s)
+        return s
+
+    def _stale_scale(self, s) -> float:
+        """The staleness-weighted apply factor ``1/(1+s)`` (ISSUE 11);
+        1.0 when weighting is off or the delta is fresh."""
+        if not self.staleness_weight:
+            return 1.0
+        w = 1.0 / (1.0 + max(0.0, float(s)))
+        if w < 1.0:
+            self._m["weighted_applies"].inc()
+        return w
+
+    def _refuse_stale(self, job: dict, sid: str, s) -> dict:
+        """Bounded staleness: beyond ``staleness_bound`` the delta must
+        never land — refused, counted, and the job re-queued WITHOUT a
+        bad-reply strike (staleness is the fleet's timing, not a
+        malformed reply; a straggler's job must be re-dispatched, not
+        dropped).  Bounded on its OWN budget though: a peer whose stamp
+        echo is deterministically broken (every delta beyond the bound
+        forever) must not livelock the refuse/refetch cycle — after
+        ``MAX_BAD_REPLIES`` stale refusals a non-tail job is dropped
+        like a repeatedly-malformed one (a TAIL job is always re-queued:
+        the epoch cannot close without its feed)."""
+        import logging
+
+        self._m["stale_refused"].inc()
+        job["_stale_refusals"] = job.get("_stale_refusals", 0) + 1
+        requeue = (bool(job.get("last_minibatch"))
+                   or job["_stale_refusals"] < self.MAX_BAD_REPLIES)
+        logging.getLogger("znicz").info(
+            "slave %s: delta staleness %s > bound %d — refused and %s",
+            sid, s, self.staleness_bound,
+            "re-queued" if requeue else
+            "DROPPED (repeated stale refusals)")
+        if requeue:
+            self._pending.append(job)
+        return {"ok": False, "stale_refused": True, "staleness": int(s),
+                "error": f"delta staleness {s} exceeds the "
+                         f"{self.staleness_bound}-apply bound"}
+
+    def staleness_summary(self) -> Dict[str, dict]:
+        """Per-leaf staleness digest for the web_status panel:
+        observation count, p50 and max over the recent window."""
+        out = {}
+        for sid, h in sorted(dict(self._stale_hist).items()):
+            data = h.window()
+            if data.size:
+                out[sid] = {"count": int(h.count),
+                            "p50": float(np.median(data)),
+                            "max": int(data.max())}
+        return out
+
+    def member_count(self) -> int:
+        """Live training membership, the quorum's denominator: direct
+        non-relay slaves plus the subtree leaf counts live relays report
+        on their job requests (``leaves``) — so a preempted subtree
+        shrinks the count as soon as its relay stops polling or reports
+        fewer children."""
+        slaves = dict(self.slaves)      # copy: read from the web thread
+        n = sum(1 for sid in slaves if sid not in self.relays)
+        n += sum(int(self._relay_leaves.get(sid, 0))
+                 for sid in slaves if sid in self.relays)
+        return n
+
+    def quorum_met(self) -> bool:
+        return self.min_slaves <= 0 or self.member_count() >= \
+            self.min_slaves
+
+    def degraded(self) -> bool:
+        """True while the fleet sits below the quorum gate mid-run —
+        the /readyz-style membership signal (web_status.readiness)."""
+        return not self.quorum_met() and not bool(self.decision.complete)
+
+    def _replan(self, why: str) -> None:
+        """``plan_tree`` promoted to a RUNTIME re-planner (ISSUE 11):
+        whenever live-relay membership changes (a relay joins, or TTL
+        eviction removes one) the master recomputes its view of the
+        tree — the live relays, their binds and reported subtree sizes
+        — which is what ``rehome`` assignment and the topology panel
+        dispatch against.  Orphaned children re-home through the
+        existing re-registration path and lost jobs come back through
+        the existing reaper, so a re-plan never loses or double-applies
+        work."""
+        import logging
+
+        slaves = dict(self.slaves)
+        live = [{"id": sid, "bind": self.relay_binds.get(sid),
+                 "leaves": int(self._relay_leaves.get(sid, 0))}
+                for sid in sorted(slaves) if sid in self.relays]
+        self._tree_plan = {"relays": live, "reason": why,
+                           "members": self.member_count()}
+        self._m["replans"].inc()
+        logging.getLogger("znicz").info(
+            "tree re-planned (%s): %d live relays, %d members", why,
+            len(live), self._tree_plan["members"])
+
+    @property
+    def tree_plan(self) -> Optional[dict]:
+        plan = self._tree_plan
+        return None if plan is None else dict(plan)
+
+    def _rehome_target(self) -> Optional[str]:
+        """A live relay bind for an orphaned leaf to re-home behind —
+        round-robin over relays seen RECENTLY (well inside slave_ttl:
+        a healthy relay polls sub-second, so a relay silent for
+        several seconds is not a safe rehome target even before its
+        TTL eviction)."""
+        now = time.time()
+        window = min(self.slave_ttl, 10.0) if self.slave_ttl > 0 else 10.0
+        targets = [self.relay_binds[sid]
+                   for sid, seen in sorted(dict(self.slaves).items())
+                   if sid in self.relays and sid in self.relay_binds
+                   and now - seen <= window]
+        if not targets:
+            return None
+        self._rehome_rr = (self._rehome_rr + 1) % (1 << 30)
+        return targets[self._rehome_rr % len(targets)]
+
+    def jobs_ledger(self) -> Dict:
+        """The no-silent-loss / no-double-apply cross-check (ISSUE 11
+        acceptance): every dispatched job id ends in EXACTLY one bucket
+        — done, reaper/sibling re-queue, refused (malformed /
+        quarantined / stale-beyond-bound; the re-queued copy
+        re-dispatches under a NEW id), or still in flight.  ``balanced``
+        is the invariant; it holds for any master that never restored a
+        resume snapshot (restore jumps the job-id sequence by design,
+        so pre-crash ids can never collide)."""
+        out = {
+            "dispatched": int(self._job_seq),
+            "jobs_done": int(self.jobs_done),
+            "jobs_requeued": int(self.jobs_requeued),
+            "bad_updates": int(self.bad_updates),
+            "quarantined_updates": int(self.quarantined_updates),
+            "stale_refused": int(self.stale_refused),
+            "in_flight": len(self._inflight),
+        }
+        out["balanced"] = out["dispatched"] == (
+            out["jobs_done"] + out["jobs_requeued"] + out["bad_updates"]
+            + out["quarantined_updates"] + out["stale_refused"]
+            + out["in_flight"])
+        return out
 
     def _scheduled_hypers(self) -> Optional[Dict]:
         """The per-layer (lr, lr_bias) a TRAIN minibatch dispatched at
@@ -515,11 +763,13 @@ class Server:
             "loader_pos": int(self.loader._pos),
             "hold": self._hold,
             "outstanding": [
-                {k: v for k, v in j.items() if k != "_bad_replies"}
+                {k: v for k, v in j.items()
+                 if k not in ("_bad_replies", "_stale_refusals")}
                 for j in self._outstanding()],
             "job_seq": self._job_seq,
             "jobs_by_slave": dict(self.jobs_by_slave),
             "lr_iteration": self._lr_iteration,
+            "apply_step": self._apply_step,
             "decision_acc": acc,
             "durations": list(self._durations),
             "delta_norms": list(self._delta_norms),
@@ -537,6 +787,12 @@ class Server:
                 "update_bytes_in": self.update_bytes_in,
                 "prefetch_hit": self.prefetch_hit,
                 "aggregated_updates": self.aggregated_updates,
+                # elastic accounting (ISSUE 11): a master crash
+                # mid-degraded-mode must restore EXACT books
+                "stale_refused": self.stale_refused,
+                "weighted_applies": self.weighted_applies,
+                "replans": self.replans,
+                "preemptions_ridden": self.preemptions_ridden,
                 "tensor_bytes_raw_in": self.tensor_bytes_raw_in,
                 "tensor_bytes_wire_in": self.tensor_bytes_wire_in,
                 "tensor_bytes_raw_out": self.tensor_bytes_raw_out,
@@ -574,6 +830,7 @@ class Server:
         self._job_seq = int(m.get("job_seq", 0)) + 100_000
         self.jobs_by_slave = dict(m.get("jobs_by_slave", {}))
         self._lr_iteration = int(m.get("lr_iteration", 0))
+        self._apply_step = int(m.get("apply_step", 0))
         self._durations = collections.deque(m.get("durations", []),
                                             maxlen=64)
         self._delta_norms = collections.deque(m.get("delta_norms", []),
@@ -733,16 +990,33 @@ class Server:
                 self._m["reregistrations"].inc()
             self._ever_registered.add(sid)
             self.registered.add(sid)
+            newly_live = sid not in self.slaves
             if req.get("relay"):
                 # an aggregation-tree relay (ISSUE 10): a first-class
                 # member (TTL, eviction, reap all apply), marked so the
                 # topology panel can draw the tree
                 self.relays.add(sid)
+                if req.get("bind"):
+                    self.relay_binds[sid] = str(req["bind"])
             self.slaves[sid] = time.time()
-            return {"ok": True, "version": PROTOCOL_VERSION,
-                    "class_lengths": list(self.loader.class_lengths),
-                    "resumed": self.resumed,
-                    "epoch": int(self.loader.epoch_number)}
+            if req.get("relay") and newly_live:
+                # relay membership grew mid-run: re-plan (ISSUE 11)
+                self._replan(f"relay {sid} joined")
+            rep = {"ok": True, "version": PROTOCOL_VERSION,
+                   "class_lengths": list(self.loader.class_lengths),
+                   "resumed": self.resumed,
+                   "epoch": int(self.loader.epoch_number)}
+            if self.elastic_rehome and not req.get("relay"):
+                # runtime tree healing (ISSUE 11): a LEAF registering
+                # directly while live relays exist is an orphan (its
+                # relay died and it fell back here) — steer it back
+                # under the tree; the client keeps THIS endpoint as its
+                # fallback, so a dead rehome target costs one more
+                # backoff window, never the slave
+                target = self._rehome_target()
+                if target:
+                    rep["rehome"] = target
+            return rep
         if cmd in ("job", "update") and sid not in self.registered:
             # the handshake is a gate, not advice: a refused (or never
             # registered) peer gets no params and applies no deltas.
@@ -753,6 +1027,23 @@ class Server:
         if cmd == "job":
             if bool(self.decision.complete):
                 return {"done": True}
+            if sid in self.relays and req.get("leaves") is not None:
+                # relays piggyback their live subtree LEAF count on
+                # every job request (ISSUE 11) — the quorum's view
+                # through trees, self-healing: a dead subtree stops
+                # polling and its count ages out with its relay
+                try:
+                    self._relay_leaves[sid] = max(0, int(req["leaves"]))
+                except (TypeError, ValueError):
+                    pass
+            if not self.quorum_met():
+                # quorum gate (ISSUE 11): below min_slaves the master
+                # PAUSES dispatch — peers wait (and re-ask) instead of
+                # burning the job stream on a fleet too small to make
+                # progress; readiness reports degraded meanwhile
+                return {"wait": True, "degraded": True,
+                        "members": self.member_count(),
+                        "min_slaves": self.min_slaves}
             # batched fetch (ISSUE 10): a relay asks with count=k and
             # gets up to k jobs under ONE params broadcast — the
             # O(slaves) -> O(fanout) flip on the job-request side.  A
@@ -772,9 +1063,14 @@ class Server:
                 # dict key — the slave echoes it in the update, spans
                 # on both sides carry it, and an old peer that ignores
                 # it still works.
+                # ``step``: the apply-counter stamp (ISSUE 11) — the
+                # params version this job computes against; the slave
+                # echoes it with its update, and the delta's staleness
+                # is the applies elapsed since
                 entries.append({"job_id": jid, "job": job,
                                 "trace_id": f"{self._run_tag}-{jid}",
-                                "train": job["class"] == TRAIN})
+                                "train": job["class"] == TRAIN,
+                                "step": self._apply_step})
             if not entries:
                 if job is self._WAIT:
                     return {"wait": True}   # client sleeps and re-asks
@@ -836,13 +1132,17 @@ class Server:
                     job, sid, "metrics payload is "
                               f"{type(req.get('metrics')).__name__}, "
                               "not a dict")
+            s = self._staleness(req.get("step"), sid)
             if req.get("deltas"):
+                if self.staleness_bound > 0 and s > self.staleness_bound:
+                    return self._refuse_stale(job, sid, s)
                 reason = self._quarantine_reason(req["deltas"])
                 if reason:
                     return self._refuse_update(
                         job, sid, f"delta quarantined: {reason}",
                         counter="quarantined_updates", quarantined=True)
-                self.apply_deltas(req["deltas"])
+                self.apply_deltas(req["deltas"],
+                                  scale=self._stale_scale(s))
             # async arrivals after completion must not rewind decision state
             if not bool(self.decision.complete):
                 if "minibatches" in job:
@@ -893,7 +1193,7 @@ class Server:
                              "dicts")
         now = time.time()
         n_delta = sum(1 for c in contributors if c.get("delta"))
-        fresh: List[tuple] = []         # (contrib, job) accepted so far
+        fresh: List[tuple] = []         # (contrib, job, staleness)
         malformed: List[tuple] = []     # (contrib, job, why)
         outcomes: Dict = {}
         for c in contributors:
@@ -906,6 +1206,10 @@ class Server:
             job, t_issued, _ = entry
             self._durations.append(now - t_issued)
             cid = str(c.get("id", sid))
+            # per-LEAF staleness (ISSUE 11): the manifest carries each
+            # contributor's job stamp, so the histograms and the bound
+            # see through the tree exactly as through the star
+            s = self._staleness(c.get("step"), cid)
             if c.get("refused"):
                 self._refuse_update(
                     job, cid, f"delta quarantined at relay {sid!r}: "
@@ -931,7 +1235,7 @@ class Server:
                 malformed.append((c, job, why))
                 outcomes[jid] = "refused"
                 continue
-            fresh.append((c, job))
+            fresh.append((c, job, s))
         deltas = req.get("deltas")
         if malformed and deltas and any(c.get("delta")
                                         for c, _, _ in malformed):
@@ -943,7 +1247,7 @@ class Server:
             # back via the reaper's counter with no strike
             for c, job, why in malformed:
                 self._refuse_update(job, str(c.get("id", sid)), why)
-            for c, job in fresh:
+            for c, job, _ in fresh:
                 self._pending.append(job)
                 self._m["jobs_requeued"].inc()
                 outcomes[c.get("job_id")] = "requeued"
@@ -955,16 +1259,43 @@ class Server:
             # per-child exactly like the star — nothing of theirs is
             # in the sum
             self._refuse_update(job, str(c.get("id", sid)), why)
+        if deltas and self.staleness_bound > 0:
+            # bounded staleness through the tree (ISSUE 11): a
+            # delta-bearing contributor past the bound is baked into
+            # the INDIVISIBLE sum, so — exactly like the malformed
+            # abort — the whole aggregate is refused: the over-bound
+            # children re-queue under ``stale_refused`` with no
+            # strike, their innocent siblings under ``jobs_requeued``
+            # with no strike, and nothing lands twice when the
+            # re-dispatched jobs come back
+            over, rest = [], []
+            for t in fresh:
+                (over if (t[0].get("delta")
+                          and t[2] > self.staleness_bound)
+                 else rest).append(t)
+            if over:
+                for c, job, s in over:
+                    self._refuse_stale(job, str(c.get("id", sid)), s)
+                    outcomes[c.get("job_id")] = "stale_refused"
+                for c, job, _ in rest:
+                    self._pending.append(job)
+                    self._m["jobs_requeued"].inc()
+                    outcomes[c.get("job_id")] = "requeued"
+                return {"ok": False, "stale_refused": True,
+                        "outcomes": outcomes,
+                        "error": "aggregate refused: "
+                                 f"{len(over)} contributor delta(s) "
+                                 "beyond the staleness bound"}
         # the apply is gated on a FRESH delta-bearing contributor: a
         # relay re-sends the same flush bytes after a lost reply (the
         # client's resend discipline), and on the second delivery every
         # contributor pops as stale — the sum must then be DROPPED like
         # a stale star update, or the gradient lands twice
-        if deltas and any(c.get("delta") for c, _ in fresh):
+        if deltas and any(c.get("delta") for c, _, _ in fresh):
             reason = self._quarantine_reason(deltas,
                                              n_contrib=max(1, n_delta))
             if reason:
-                for c, job in fresh:
+                for c, job, _ in fresh:
                     self._refuse_update(
                         job, str(c.get("id", sid)),
                         f"aggregated delta quarantined: {reason}",
@@ -972,8 +1303,17 @@ class Server:
                 return {"ok": False, "quarantined": True,
                         "error": f"delta quarantined: {reason}",
                         "outcomes": outcomes}
-            self.apply_deltas(deltas)
-        for c, job in fresh:
+            # staleness-weighted apply of the indivisible sum: one
+            # scale for all contributors — their MEAN staleness (the
+            # sum already mixes their gradients; the mean discounts it
+            # exactly as much as the per-contributor weights would on
+            # average)
+            stales = [s for c, _, s in fresh if c.get("delta")]
+            self.apply_deltas(
+                deltas,
+                scale=self._stale_scale(float(np.mean(stales))
+                                        if stales else 0.0))
+        for c, job, _ in fresh:
             # async arrivals after completion must not rewind decision
             # state (same guard as the star path)
             if not bool(self.decision.complete):

@@ -150,6 +150,22 @@ class WebStatus:
                 "resume_saves": srv.resume_saves,
                 "job_timeout_s": round(srv.effective_job_timeout(), 3),
                 "aggregated_updates": srv.aggregated_updates,
+                # elastic async training (ISSUE 11): quorum state,
+                # staleness policy + per-leaf histograms, re-planner
+                "elastic": {
+                    "min_slaves": srv.min_slaves,
+                    "members": srv.member_count(),
+                    "degraded": bool(srv.degraded()),
+                    "apply_step": srv.apply_step,
+                    "staleness_bound": srv.staleness_bound,
+                    "staleness_weight": bool(srv.staleness_weight),
+                    "stale_refused": srv.stale_refused,
+                    "weighted_applies": srv.weighted_applies,
+                    "replans": srv.replans,
+                    "preemptions_ridden": srv.preemptions_ridden,
+                    "staleness_by_leaf": srv.staleness_summary(),
+                    "tree_plan": srv.tree_plan,
+                },
                 "slaves": [
                     {"id": sid,
                      "jobs": jobs_by_slave.get(sid, 0),
@@ -207,9 +223,25 @@ class WebStatus:
 
     def readiness(self) -> dict:
         """The ``/readyz`` body: ready iff a registered inference
-        service is up, warmed, not mid-rollover and not draining."""
+        service is up, warmed, not mid-rollover and not draining — or,
+        with only a training MASTER registered (ISSUE 11), iff its
+        elastic quorum is met (503 while degraded is the membership
+        signal an operator's dashboards key on during preemptions)."""
         inf = self.inference
         if inf is None:
+            srv = self.server
+            if srv is not None:
+                members = srv.member_count()
+                if srv.degraded():
+                    return {"ready": False,
+                            "reason": f"degraded: {members} members "
+                                      f"below the min_slaves quorum "
+                                      f"({srv.min_slaves})",
+                            "members": members,
+                            "min_slaves": srv.min_slaves}
+                return {"ready": True, "reason": "ok",
+                        "members": members,
+                        "min_slaves": srv.min_slaves}
             return {"ready": False,
                     "reason": "no inference service registered"}
         if inf.ready():
@@ -283,6 +315,33 @@ class WebStatus:
                     master_html = ""
                     master = snap.get("master")
                     if master:
+                        ela = master.get("elastic", {})
+                        stale_rows = "".join(
+                            f"<tr><td>{html.escape(leaf)}</td>"
+                            f"<td>{st['count']}</td><td>{st['p50']}</td>"
+                            f"<td>{st['max']}</td></tr>"
+                            for leaf, st in sorted(
+                                ela.get("staleness_by_leaf",
+                                        {}).items()))
+                        elastic_html = (
+                            "<p>elastic: "
+                            f"{'DEGRADED' if ela.get('degraded') else 'ok'}"
+                            f", members {ela.get('members')}"
+                            f"/{ela.get('min_slaves')} min, apply step "
+                            f"{ela.get('apply_step')}, staleness bound "
+                            f"{ela.get('staleness_bound')}"
+                            f" (weighting "
+                            f"{'on' if ela.get('staleness_weight') else 'off'}"
+                            f"), stale refused {ela.get('stale_refused')}"
+                            f", weighted applies "
+                            f"{ela.get('weighted_applies')}, re-plans "
+                            f"{ela.get('replans')}, preemptions ridden "
+                            f"{ela.get('preemptions_ridden')}</p>")
+                        if stale_rows:
+                            elastic_html += (
+                                "<table border=1><tr><th>leaf</th>"
+                                "<th>staleness n</th><th>p50</th>"
+                                f"<th>max</th></tr>{stale_rows}</table>")
                         srows = "".join(
                             f"<tr><td>{html.escape(s['id'])}"
                             f"{' (relay)' if s.get('relay') else ''}"
@@ -307,6 +366,7 @@ class WebStatus:
                             "compression ratio: "
                             f"{master['compression_ratio']}, prefetch "
                             f"hits: {master['prefetch_hit']}</p>"
+                            f"{elastic_html}"
                             "<table border=1><tr><th>slave</th><th>jobs"
                             f"</th><th>last seen</th></tr>{srows}</table>"
                             f"<p>dead slaves: {len(master['dead_slaves'])}"
