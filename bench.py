@@ -74,6 +74,17 @@ p50 overhead gate at the same operating point (FAILS above 2% — ISSUE
 stream (bucket-ladder jit cache).  All gates are relative to same-host,
 same-phase measurements, so they are TPU-independent.
 
+``python bench.py --fleet`` gates the replica-fleet serving plane
+(znicz_tpu/serving/balancer.py, ISSUE 12) in one JSON line: a
+3-replica fleet behind the health-checked balancer under a seeded
+kill-and-restart timetable must lose ZERO acknowledged requests
+(ledger: accepted == replied + refused), keep goodput within band of a
+fault-free window measured in the same process, complete a canary
+rollover triggered MID-chaos with every reply's generation stamp
+consistent with the wave, and auto-roll-back a forced
+parity-regression canary with the fleet still serving the old
+generation bit-exactly.
+
 ``python bench.py --telemetry`` gates the unified telemetry layer
 (znicz_tpu/telemetry/, ISSUE 5): interleaved enabled/disabled best-of
 windows of the real fused training loop; FAILS if spans + hot-loop
@@ -1674,6 +1685,316 @@ def serve_main() -> None:
         raise SystemExit("serving gates failed: " + "; ".join(failures))
 
 
+#: --fleet protocol knobs (ISSUE 12).  Three gates over a real
+#: 3-replica fleet behind the ReplicaBalancer, all RELATIVE to
+#: same-process fault-free measurements (TPU-independent): (1) a seeded
+#: kill-and-restart chaos run loses zero acknowledged requests (ledger
+#: accepted == replied + refused) with goodput within band of
+#: fault-free, (2) a canary rollover triggered MID-chaos completes with
+#: every reply's generation stamp consistent with the wave, (3) a
+#: forced parity-regression canary auto-rolls-back with the fleet still
+#: serving the old generation bit-exactly.  The model is a thin MNIST
+#: MLP — the fleet gates measure COORDINATION (failover, hedging,
+#: rollover), not batch compute, so restart warmups must stay cheap on
+#: this 1-core host.
+FLEET_REPLICAS = 3
+FLEET_HIDDEN = 256
+FLEET_MAX_BATCH = 8
+FLEET_RATE_QPS = 25.0       # open-loop offered load, single-row
+FLEET_FAULTFREE_S = 8.0     # fault-free goodput window
+FLEET_CHAOS_S = 24.0        # seeded kill/restart + rollover window
+FLEET_SETTLE_S = 6.0        # post-chaos drain/heal window
+FLEET_SWAP_AT_S = 5.0       # rollover trigger inside the chaos window
+FLEET_GOODPUT_BAND = 0.45   # chaos goodput >= band x fault-free (2 of
+#                             3 replicas die once each mid-window on a
+#                             1-core host whose restarts recompile)
+FLEET_SEED = 1207
+
+
+def _build_fleet_workflow():
+    """A thin MNIST MLP, seeded so every call builds BIT-IDENTICAL
+    params — three replicas built this way answer bit-exactly alike,
+    which is what the parity probes and per-generation oracles ride."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = 256
+    root.mnist.loader.n_valid = 64
+    root.mnist.loader.minibatch_size = 64
+    root.mnist.layers = [FLEET_HIDDEN, 10]
+
+    from znicz_tpu.samples import mnist
+
+    try:
+        wf = mnist.MnistWorkflow()
+    finally:
+        root.mnist.layers = [100, 10]
+    wf.initialize(device=None)
+    return wf
+
+
+def fleet_main() -> None:
+    """``--fleet``: the replica-balancer gates (ISSUE 12), one JSON
+    line; gates AFTER the line so a trip never destroys the record."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from znicz_tpu.parallel.chaos import (FaultSchedule, ReplicaHarness,
+                                          SubtreePreempter)
+    from znicz_tpu.serving import InferenceClient, ReplicaBalancer
+
+    sys.setswitchinterval(1e-3)
+
+    tmp = tempfile.mkdtemp(prefix="znicz_fleet_")
+    wf0 = _build_fleet_workflow()
+    wf0.snapshotter.directory = tmp
+    path_a = wf0.snapshotter.save("fleet_a")
+    path_b = os.path.join(tmp, "fleet_b" + path_a[path_a.index("."):])
+    shutil.copy(path_a, path_b)     # SAME params, distinct path: the
+    # healthy rollover (parity must hold bit-exactly across it)
+    for f in wf0.forwards:          # the broken "upgrade": perturbed
+        for k, a in f.params().items():
+            a.mem = np.asarray(a.map_read()) * np.float32(1.25) \
+                + np.float32(0.01)
+    path_bad = wf0.snapshotter.save("fleet_bad")
+
+    # canary_p99_mult is WIDE here on purpose: mid-chaos, both old
+    # replicas can be down at once, so the freshly-warmed canary
+    # absorbs a parked-request burst whose queueing p99 is legitimate
+    # load, not a regression — the healthy-wave gate is coordination +
+    # PARITY; the p99-regression verdict itself is pinned under
+    # controlled timing by the tier-1 scripted-canary test
+    balancer = ReplicaBalancer(
+        replica_ttl_s=1.2, failover_timeout_s=1.0, failover_tries=4,
+        hedge_floor_s=0.4, canary_requests=20, parity_every=3,
+        canary_timeout_s=30.0, canary_p99_mult=100.0,
+        min_replicas=2).start()
+
+    from znicz_tpu.serving import InferenceServer
+
+    wfs = [_build_fleet_workflow() for _ in range(FLEET_REPLICAS)]
+    binds = ["tcp://127.0.0.1:*"] * FLEET_REPLICAS
+
+    def make_factory(i):
+        def make():
+            return InferenceServer(
+                wfs[i], bind=binds[i], snapshot=path_a,
+                max_batch=FLEET_MAX_BATCH, max_delay_ms=2.0,
+                queue_bound=64, announce=balancer.endpoint,
+                replica_id=f"r{i}")
+        return make
+
+    harnesses = [ReplicaHarness(make_factory(i))
+                 for i in range(FLEET_REPLICAS)]
+    for i, h in enumerate(harnesses):
+        h.start()
+        binds[i] = h.server.endpoint    # restarts rebind the same port
+    t0 = _time.perf_counter()
+    while balancer.ready_count() < FLEET_REPLICAS:
+        if _time.perf_counter() - t0 > 60:
+            raise SystemExit("fleet never became ready")
+        _time.sleep(0.05)
+
+    cli = InferenceClient(balancer.endpoint, timeout=25.0,
+                          resend_after_s=60.0, breaker_failures=0)
+    rng = np.random.default_rng(FLEET_SEED)
+    x1 = rng.normal(0, 1, (1, 28 * 28)).astype(np.float32)
+
+    infer_rids = set()
+    answers: dict = {}              # rid -> (t_wall, ok, gen)
+    gen_events: list = []           # (t_wall, gen) of ok replies
+
+    def pump(wait=0.002):
+        for rep in cli.collect(wait):
+            rid = rep.get("req_id")
+            if rid not in infer_rids:
+                continue
+            if rid in answers:
+                raise SystemExit(f"req {rid} answered twice — "
+                                 f"exactly-once broken")
+            ok = bool(rep.get("ok"))
+            answers[rid] = (_time.perf_counter(), ok, rep.get("gen"))
+            if ok:
+                gen_events.append((_time.perf_counter(), rep["gen"]))
+
+    def drive(duration_s, on_tick=None):
+        """Open-loop single-row arrivals at FLEET_RATE_QPS; returns
+        (ok replies landed in-window, elapsed)."""
+        n0_ok = sum(1 for _, ok, _ in answers.values() if ok)
+        t0 = _time.perf_counter()
+        i = 0
+        while _time.perf_counter() - t0 < duration_s:
+            now = _time.perf_counter() - t0
+            if now >= i / FLEET_RATE_QPS and cli.in_flight < 256:
+                infer_rids.add(cli.submit(x1))
+                i += 1
+            if on_tick is not None:
+                on_tick(now)
+            pump()
+        elapsed = _time.perf_counter() - t0
+        return (sum(1 for _, ok, _ in answers.values() if ok) - n0_ok,
+                elapsed)
+
+    def drain(budget_s=20.0):
+        t0 = _time.perf_counter()
+        while cli.in_flight and _time.perf_counter() - t0 < budget_s:
+            pump(0.02)
+
+    # ---- phase 1: fault-free goodput ------------------------------------
+    ok_ff, el_ff = drive(FLEET_FAULTFREE_S)
+    drain()
+    goodput_ff = ok_ff / el_ff
+    ledger_ff = balancer.ledger()
+
+    # ---- phase 2: seeded kill/restart chaos + MID-chaos rollover --------
+    # r1/r2 each die once on their own seeded timetable while the wave
+    # (canary r0) runs; r0 is preempted LATE — after the wave should
+    # have promoted — so the heal path (restart -> boot snapshot ->
+    # re-swap onto the fleet path) is exercised too
+    # r1 and r2 die in SERIALIZED seeded windows (a rolling
+    # preemption): overlapping both kills against the canary warm
+    # would measure a one-survivor fleet, which the goodput band — not
+    # the rollover gate — is the honest judge of
+    preempters = [
+        SubtreePreempter(FaultSchedule(FLEET_SEED + 1),
+                         [("r1", harnesses[1].kill,
+                           harnesses[1].restart)],
+                         kill_s=(2.0, 5.0), down_s=(2.0, 3.0)),
+        SubtreePreempter(FaultSchedule(FLEET_SEED + 2),
+                         [("r2", harnesses[2].kill,
+                           harnesses[2].restart)],
+                         kill_s=(9.0, 12.0), down_s=(2.0, 3.0)),
+        SubtreePreempter(FaultSchedule(FLEET_SEED + 3),
+                         [("r0", harnesses[0].kill,
+                           harnesses[0].restart)],
+                         kill_s=(16.0, 19.0), down_s=(2.0, 3.0)),
+    ]
+    swap_state = {"sent": False, "t_sent": None, "rid": None}
+
+    def maybe_swap(now):
+        if not swap_state["sent"] and now >= FLEET_SWAP_AT_S:
+            swap_state["sent"] = True
+            swap_state["t_sent"] = _time.perf_counter()
+            swap_state["rid"] = cli._send({"cmd": "swap",
+                                          "path": path_b})
+
+    for p in preempters:
+        p.start()
+    ok_chaos, el_chaos = drive(FLEET_CHAOS_S, on_tick=maybe_swap)
+    for p in preempters:
+        p.join(timeout=60)
+    # settle: drain the tail, let restarted replicas re-announce and
+    # heal onto the promoted path
+    t_settle0 = _time.perf_counter()
+    drive(FLEET_SETTLE_S)
+    drain()
+    goodput_chaos = ok_chaos / el_chaos
+    ledger_chaos = balancer.ledger()
+    history = list(balancer.rollover_history)
+    promoted = [h for h in history if h["result"] == "promoted"]
+    gens_seen = sorted({g for _, g in gen_events})
+    pre_swap_gen2 = [1 for t, g in gen_events
+                     if swap_state["t_sent"] is not None
+                     and t < swap_state["t_sent"] and g != 1]
+    late_old_gen = [1 for t, g in gen_events
+                    if t > t_settle0 + FLEET_SETTLE_S * 0.7 and g != 2]
+    unanswered = [rid for rid in infer_rids if rid not in answers]
+    fleet_stats = balancer.stats()
+
+    # ---- phase 3: forced parity regression must auto-roll-back ----------
+    pre_y = cli.result(cli.submit(x1))["y"]
+    cli._send({"cmd": "swap", "path": path_bad})
+    t0 = _time.perf_counter()
+    while not balancer.rollbacks and _time.perf_counter() - t0 < 40:
+        r = cli.submit(x1)
+        infer_rids.add(r)
+        pump(0.01)
+    drain()
+    regression = balancer.rollover_history[-1] if \
+        balancer.rollover_history else {}
+    post_y = cli.result(cli.submit(x1))["y"]
+    post_gen = cli.result(cli.submit(x1))["gen"]
+    bitexact_after_rollback = bool(
+        np.array_equal(pre_y, post_y))
+    ledger_final = balancer.ledger()
+
+    record = {
+        "metric": "fleet_chaos_goodput",
+        "value": round(goodput_chaos, 2),
+        "unit": "ok_replies/sec",
+        "vs_faultfree": round(goodput_chaos / max(goodput_ff, 1e-9), 3),
+        "goodput_faultfree": round(goodput_ff, 2),
+        "goodput_band": FLEET_GOODPUT_BAND,
+        "replicas": FLEET_REPLICAS,
+        "rate_qps": FLEET_RATE_QPS,
+        "seed": FLEET_SEED,
+        "preemptions": sum(p.preemptions for p in preempters),
+        "ledger_faultfree": ledger_ff,
+        "ledger_chaos": ledger_chaos,
+        "ledger_final": ledger_final,
+        "unanswered": len(unanswered),
+        "gens_seen": gens_seen,
+        "pre_swap_gen2_replies": len(pre_swap_gen2),
+        "late_old_gen_replies": len(late_old_gen),
+        "rollover_history": history,
+        "regression": regression,
+        "bitexact_after_rollback": bitexact_after_rollback,
+        "post_rollback_gen": post_gen,
+        "failovers": balancer.failovers,
+        "hedges": balancer.hedges,
+        "hedge_wins": balancer.hedge_wins,
+        "hedge_delay_ms": fleet_stats["hedge_delay_ms"],
+        "dup_replies_dropped": balancer.dup_replies_dropped,
+        "heals": balancer.heals,
+        "replicas_lost": balancer.replicas_lost,
+        "parity_checks": balancer.parity_checks,
+        "parity_mismatches": balancer.parity_mismatches,
+    }
+    print(json.dumps(record))
+    cli.close()
+    balancer.stop()
+    for h in harnesses:
+        h.kill()
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if not ledger_final["balanced"] or ledger_final["in_flight"]:
+        failures.append(f"ledger leaked: {ledger_final}")
+    if unanswered:
+        failures.append(f"{len(unanswered)} acknowledged requests "
+                        f"never answered (no reply, no refusal)")
+    if goodput_chaos < FLEET_GOODPUT_BAND * goodput_ff:
+        failures.append(
+            f"chaos goodput {goodput_chaos:.1f}/s < "
+            f"{FLEET_GOODPUT_BAND} x fault-free {goodput_ff:.1f}/s")
+    if len(promoted) != 1:
+        failures.append(f"expected exactly one promoted rollover "
+                        f"mid-chaos, saw {history}")
+    if gens_seen and (min(gens_seen) < 1 or max(gens_seen) > 2):
+        failures.append(f"generation stamps outside the wave: "
+                        f"{gens_seen}")
+    if pre_swap_gen2:
+        failures.append(f"{len(pre_swap_gen2)} replies stamped the NEW "
+                        f"generation before the swap was even sent")
+    if late_old_gen:
+        failures.append(f"{len(late_old_gen)} replies still stamped "
+                        f"the old generation after promote + heal "
+                        f"settle")
+    if regression.get("result") != "rolled_back":
+        failures.append(f"forced parity regression did not auto-roll-"
+                        f"back: {regression}")
+    if not bitexact_after_rollback:
+        failures.append("post-rollback fleet output differs from the "
+                        "pre-swap generation (bit-exactness broken)")
+    if balancer.parity_mismatches < 1:
+        failures.append("the perturbed snapshot produced no parity "
+                        "mismatch — the probe path cannot be live")
+    shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        raise SystemExit("fleet gates failed: " + "; ".join(failures))
+
+
 #: --telemetry protocol knobs (ISSUE 5).  Same de-flake discipline as
 #: --serve / the PR-4 snapshot guard: enabled/disabled windows are
 #: INTERLEAVED (this container's cgroup CPU share swings minute to
@@ -2101,6 +2422,8 @@ if __name__ == "__main__":
         agg_main()
     elif "--serve" in args:
         serve_main()
+    elif "--fleet" in args:
+        fleet_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

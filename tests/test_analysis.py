@@ -485,3 +485,56 @@ def test_finding_render_and_key():
     assert f.render() == "a/b.py:7: r: msg"
     assert f.key == ("r", "a/b.py", "msg")
     assert f.to_json()["severity"] == "error"
+
+
+# -- zmq-loop (ISSUE 12 satellite: the single-dataplane seam) ------------------
+
+_ZMQ_FORKED = """
+    import zmq
+
+    def serve(self):
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        sock.bind("tcp://127.0.0.1:5555")
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+
+    class S:
+        def up(self):
+            import zmq
+            self._sock = zmq.Context.instance().socket(zmq.PULL)
+            self._sock.bind("inproc://x")
+"""
+
+_ZMQ_RIDES_COMMON = """
+    import zmq
+
+    def serve(self):
+        from znicz_tpu.network_common import bind_with_retry, make_poller
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        bind_with_retry(sock, "tcp://127.0.0.1:5555")
+        back = ctx.socket(zmq.DEALER)
+        back.connect("tcp://127.0.0.1:5556")      # connect: no race
+        poller = make_poller(sock, back)
+
+    def not_a_socket(self):
+        server = HTTPServer()
+        server.bind(("127.0.0.1", 0))             # not a ZMQ socket
+"""
+
+
+def test_zmq_loop_fixture_pair():
+    from znicz_tpu.analysis.zmq_loop import ZmqLoopChecker
+
+    findings = _check(ZmqLoopChecker(), _ZMQ_FORKED)
+    rules = sorted(f.message.split(" ")[1] for f in findings)
+    # two raw binds (name + self-attr receivers) and one raw Poller
+    assert len(findings) == 3
+    assert sum("Poller" in f.message for f in findings) == 1
+    assert sum("bind_with_retry" in f.message for f in findings) == 2
+    assert not _check(ZmqLoopChecker(), _ZMQ_RIDES_COMMON)
+    # network_common itself is the sanctioned home
+    assert not _check(ZmqLoopChecker(), _ZMQ_FORKED,
+                      rel="network_common.py")

@@ -362,3 +362,48 @@ def test_wire_compress_params_broadcast(tmp_path):
     assert srv.wire_compress == "zlib"
     ratio = srv.compression_ratio("out")
     assert ratio is not None and ratio > 1.0, ratio
+
+
+def test_peek_and_restamp_share_tensor_frames():
+    """The balancer's forward path (ISSUE 12): peek reads the skeleton
+    without materializing tensors, restamp rewrites top-level keys while
+    the tensor frames are SHARED bytes — and both refuse corruption."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    frames, _ = wire.encode_message(
+        {"cmd": "infer", "req_id": 5, "client": "c", "x": x})
+    skel = wire.peek_message(frames)
+    assert skel["cmd"] == "infer" and skel["req_id"] == 5
+    # the tensor leaf is a slot placeholder, never a materialized array
+    assert not isinstance(skel["x"], np.ndarray)
+    # restamp: req_id rewritten, lb added, client REMOVED (None), the
+    # tensor frame is the very same bytes object
+    out = wire.restamp_message(frames, req_id=99, lb=True, client=None)
+    assert out[1] is frames[1]
+    msg, _ = wire.decode_message(out)
+    assert msg["req_id"] == 99 and msg["lb"] is True
+    assert "client" not in msg
+    np.testing.assert_array_equal(msg["x"], x)
+    # round-trip restamp restores the original id byte-compatibly
+    back, _ = wire.decode_message(wire.restamp_message(out, req_id=5,
+                                                       lb=None))
+    assert back["req_id"] == 5 and "lb" not in back
+    # corruption refusals: torn metadata, a length-mismatched tensor
+    # frame, a missing frame, and legacy framing all raise at peek
+    from znicz_tpu.parallel.chaos import corrupt_payload
+
+    with pytest.raises(wire.WireError):
+        wire.peek_message([corrupt_payload(bytes(frames[0]))]
+                          + frames[1:])
+    with pytest.raises(wire.WireError):
+        wire.peek_message([frames[0],
+                           corrupt_payload(bytes(frames[1]))])
+    with pytest.raises(wire.WireError):
+        wire.peek_message(frames[:1])
+    with pytest.raises(wire.WireError):
+        wire.peek_message([pickle.dumps({"cmd": "infer"})])
+    with pytest.raises(wire.WireError):
+        wire.restamp_message([pickle.dumps({"a": 1})], lb=True)
+    # a non-dict skeleton cannot be a request: refused at peek
+    listy, _ = wire.encode_message([1, 2, 3])
+    with pytest.raises(wire.WireError):
+        wire.peek_message(listy)

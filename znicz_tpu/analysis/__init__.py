@@ -1,6 +1,6 @@
 """znicz-lint: AST static analysis tuned to this stack (ISSUE 9).
 
-Four rules over one shared AST walk of ``znicz_tpu/``:
+Five rules over one shared AST walk of ``znicz_tpu/``:
 
   - ``thread-shared-state`` — attributes mutated on a worker thread and
     accessed elsewhere with no enclosing lock (the PR 6/7
@@ -11,7 +11,11 @@ Four rules over one shared AST walk of ``znicz_tpu/``:
     read/write resolved through local aliases and checked against the
     declared DEFAULTS tables;
   - ``counter-registry``   — no new ad-hoc ``self.<counter> += 1``
-    outside the telemetry registry.
+    outside the telemetry registry;
+  - ``zmq-loop``           — no new raw ``zmq.Poller()``/socket
+    ``.bind()`` forked outside ``network_common`` (ride
+    ``make_poller``/``bind_with_retry`` — the single-dataplane seam,
+    ROADMAP item 4).
 
 Run ``python -m znicz_tpu.analysis`` (add ``--json`` for dashboards).
 Suppress one site with ``# znicz: ignore[rule]``; accept a triaged

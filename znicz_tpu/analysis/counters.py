@@ -55,6 +55,10 @@ ALLOWLIST = {
     # histogram, then reset)
     ("kohonen.py", "_batches"),
     ("kohonen.py", "total"),
+    # ScriptedReplica's scripted-accounting state (fleet test double,
+    # ISSUE 12): per-instance request count driving the stall_every
+    # script, read back by tests — not a service metric
+    ("parallel/chaos.py", "served"),
 }
 
 

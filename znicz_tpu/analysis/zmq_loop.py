@@ -1,0 +1,124 @@
+"""zmq-loop: no NEW forked ZMQ dataplane loops outside network_common.
+
+ROADMAP item 4 names the debt: the stack grew four hand-rolled ZMQ
+loops (master REP, relay, serving frontend, chaos proxy) before PR 9
+extracted the first shared piece (``network_common.bind_with_retry``)
+and ISSUE 12 the second (``network_common.make_poller``).  Every loop
+that re-forks the raw primitives re-forks the conventions with them —
+the EADDRINUSE restart-race retry, the POLLIN registration discipline,
+and (eventually) the telemetry spans and chaos hooks a single dataplane
+core will carry.  This rule keeps new planes on the shared helpers:
+
+Flagged (outside ``network_common.py``):
+
+  - ``zmq.Poller()`` instantiation — use
+    ``network_common.make_poller(*socks)``;
+  - ``.bind(...)`` on a ZMQ socket — a receiver assigned from a
+    ``*.socket(...)`` call in the same function scope (``sock =
+    ctx.socket(zmq.ROUTER); sock.bind(...)`` and the ``self._sock``
+    spelling both) — use ``network_common.bind_with_retry``.
+
+Deliberately silent: ``.connect(...)`` (no restart race to retry),
+``.bind`` on non-socket receivers (an HTTP server, argparse), and
+sockets created in one scope but bound in another (rare; the reviewer's
+job, not worth cross-function dataflow here).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Checker, Finding, Module
+
+RULE = "zmq-loop"
+
+#: the one sanctioned home for raw binds/pollers
+EXEMPT_FILES = ("network_common.py",)
+
+
+def _receiver_key(node: ast.expr) -> str | None:
+    """A trackable receiver: a bare name or a ``self.<attr>`` chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _scope_nodes(body: Iterable[ast.stmt]):
+    """Every node of one scope, PRUNING nested function bodies — they
+    are their own scopes and are scanned separately (``ast.walk`` has
+    no pruning, so a naive walk double-counts)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue                # a nested scope: scanned separately
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _socket_assigns(body: Iterable[ast.stmt]) -> set:
+    """Receiver keys assigned from a ``*.socket(...)`` call anywhere in
+    this scope (order-insensitive: ZMQ code conventionally creates and
+    binds within one function)."""
+    out = set()
+    for node in _scope_nodes(body):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "socket"):
+            for target in node.targets:
+                key = _receiver_key(target)
+                if key is not None:
+                    out.add(key)
+    return out
+
+
+class ZmqLoopChecker(Checker):
+    name = RULE
+
+    def check(self, module: Module):
+        if module.rel in EXEMPT_FILES:
+            return []
+        findings: List[Finding] = []
+        # Poller instantiation: flagged anywhere in the file
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Poller"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "zmq"):
+                findings.append(Finding(
+                    RULE, module.rel, node.lineno,
+                    "raw zmq.Poller() forked outside network_common — "
+                    "use network_common.make_poller(*socks) so every "
+                    "dataplane loop shares one poll-registration "
+                    "convention (ROADMAP item 4)"))
+        # socket binds: per function scope (+ the module scope)
+        scopes: List[Iterable[ast.stmt]] = [module.tree.body]
+        scopes += [n.body for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for body in scopes:
+            sockets = _socket_assigns(body)
+            if not sockets:
+                continue
+            for node in _scope_nodes(body):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "bind"
+                        and _receiver_key(node.func.value)
+                        in sockets):
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        "raw ZMQ socket .bind() outside "
+                        "network_common — use network_common."
+                        "bind_with_retry(sock, endpoint): a "
+                        "restarted peer races its predecessor's "
+                        "port release (EADDRINUSE), and the retry "
+                        "policy has ONE home (ROADMAP item 4)"))
+        return findings

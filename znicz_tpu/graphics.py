@@ -39,9 +39,11 @@ class GraphicsServer:
     def __init__(self, endpoint: str = "tcp://127.0.0.1:*"):
         import zmq
 
+        from znicz_tpu.network_common import bind_with_retry
+
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.XPUB)
-        self._sock.bind(endpoint)
+        bind_with_retry(self._sock, endpoint)
         self.endpoint = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
         self._subscribers = 0
 

@@ -133,6 +133,39 @@ class Launcher:
                                  "--snapshot; default bind tcp://*:5580; "
                                  "knobs: root.common.serving.max_batch/"
                                  "max_delay_ms/queue_bound)")
+        parser.add_argument("--announce", default=None,
+                            metavar="BALANCER",
+                            help="with --serve: heartbeat this replica "
+                                 "into the balancer at BALANCER "
+                                 "(ISSUE 12) — readiness, queue depth "
+                                 "and per-bucket p99 piggyback on "
+                                 "every beat")
+        parser.add_argument("--replica-id", default=None, metavar="ID",
+                            help="with --serve: stable replica identity "
+                                 "stamped on every reply (default: a "
+                                 "fresh uuid per process)")
+        parser.add_argument("--balance", nargs="?", const="tcp://*:5590",
+                            default=None, metavar="BIND",
+                            help="run the replica-fleet balancer "
+                                 "(ISSUE 12) at BIND (default "
+                                 "tcp://*:5590): health-checked "
+                                 "least-loaded dispatch over the "
+                                 "replicas that --announce into it, "
+                                 "exactly-once failover, hedged "
+                                 "retries, canary rollover with "
+                                 "auto-rollback.  Needs no workflow "
+                                 "argument; knobs: "
+                                 "root.common.serving.balance.*")
+        parser.add_argument("--replicas", default="", metavar="EP[,EP]",
+                            help="with --balance: static replica "
+                                 "endpoints to pre-connect (membership "
+                                 "still needs their heartbeats)")
+        parser.add_argument("--min-replicas", type=int, default=None,
+                            metavar="N",
+                            help="with --balance: readiness quorum "
+                                 "(root.common.serving.balance."
+                                 "min_replicas) — the aggregate "
+                                 "/readyz 503s below N ready replicas")
         parser.add_argument("--master-resume", default="", metavar="FILE",
                             help="master crash-resume file: restore "
                                  "training state from FILE when it "
@@ -157,8 +190,19 @@ class Launcher:
             root.common.engine.min_slaves = int(args.min_slaves)
         if args.staleness_bound is not None:
             root.common.engine.staleness_bound = int(args.staleness_bound)
+        if args.min_replicas is not None:
+            root.common.serving.balance.min_replicas = \
+                int(args.min_replicas)
         if args.plan_tree is not None:
             return self._plan_tree(args)
+        if args.balance is not None:
+            if args.master is not None or args.slave is not None \
+                    or args.serve is not None or args.relay is not None \
+                    or args.master_resume:
+                print("error: --balance is mutually exclusive with the "
+                      "master/slave/serve/relay roles", file=sys.stderr)
+                return 2
+            return self._balance(args)
         if args.relay is not None:
             if args.master is not None or args.slave is not None \
                     or args.serve is not None or args.master_resume:
@@ -315,6 +359,64 @@ class Launcher:
         print(json.dumps(plan, indent=2))
         return 0
 
+    def _balance(self, args) -> int:
+        """``--balance [BIND] --replicas ep,...``: run the replica
+        balancer until interrupted (or ``root.common.serving
+        .max_requests`` answers, for tests).  No workflow is built —
+        the balancer moves frames, never arrays."""
+        from znicz_tpu.serving import ReplicaBalancer
+
+        # --balance needs no workflow, so dotted overrides land in the
+        # workflow/config positional slots — reclassify and apply them
+        # here (the main flow applies overrides after role dispatch)
+        overrides = [o for o in ([args.workflow, args.config]
+                                 + list(args.overrides))
+                     if o and "=" in o]
+        stray = [o for o in (args.workflow, args.config)
+                 if o and "=" not in o]
+        if stray:
+            print(f"error: --balance takes no workflow argument "
+                  f"(got {stray})", file=sys.stderr)
+            return 2
+        if overrides:
+            apply_overrides(root, overrides)
+        replicas = tuple(ep.strip() for ep in args.replicas.split(",")
+                         if ep.strip())
+        max_requests = root.common.serving.get("max_requests", None)
+        balancer = ReplicaBalancer(
+            bind=args.balance, replicas=replicas,
+            max_requests=None if max_requests is None
+            else int(max_requests))
+        status = None
+        web_port = root.common.serving.get("web_port", None)
+        if web_port is not None:
+            from znicz_tpu.web_status import WebStatus
+
+            status = WebStatus(port=int(web_port)).start()
+            status.register_balancer(balancer)
+            print(f"fleet dashboard -> http://127.0.0.1:{status.port}/")
+        balancer.start()
+        static = (", ".join(replicas) if replicas
+                  else "none — awaiting --announce heartbeats")
+        print(f"balancing at {balancer.endpoint} (static replicas: "
+              f"{static}; quorum {balancer.min_replicas})", flush=True)
+        try:
+            while balancer.alive():
+                if balancer.max_requests is not None and \
+                        balancer.replied + balancer.refused \
+                        >= balancer.max_requests:
+                    break
+                import time
+
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            balancer.stop()
+            if status is not None:
+                status.stop()
+        return 0
+
     def _relay(self, args) -> int:
         """``--relay UPSTREAM[:BIND]``: run one relay node until its
         upstream reports training done (or Ctrl-C).  No workflow is
@@ -364,7 +466,8 @@ class Launcher:
         server = InferenceServer(
             wf, bind=args.serve, snapshot=args.snapshot,
             max_requests=None if max_requests is None
-            else int(max_requests))
+            else int(max_requests),
+            announce=args.announce, replica_id=args.replica_id)
         status = None
         web_port = root.common.serving.get("web_port", None)
         if web_port is not None:

@@ -50,7 +50,9 @@ class ZeroMQLoader(Unit):
                                 int(self.recv_timeout * 1000))
         self._socket.setsockopt(zmq.LINGER, 0)
         if self.bind:
-            self._socket.bind(self.endpoint)
+            from znicz_tpu.network_common import bind_with_retry
+
+            bind_with_retry(self._socket, self.endpoint)
         else:
             self._socket.connect(self.endpoint)
         for arr in (self.minibatch_data, self.minibatch_labels):

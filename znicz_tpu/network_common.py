@@ -119,6 +119,21 @@ def bind_with_retry(sock, endpoint: str, attempts: int = 40,
             time.sleep(delay_s)
 
 
+def make_poller(*sockets):
+    """One home for the poll-loop registration convention (the first
+    concrete step toward ROADMAP item 4's single dataplane): every ZMQ
+    serve loop — master REP, relay, serving frontend, chaos proxy,
+    replica balancer — registers its sockets POLLIN through here, and
+    znicz-lint's ``zmq-loop`` rule flags any NEW raw ``zmq.Poller()``/
+    ``.bind()`` forked outside this module."""
+    import zmq
+
+    poller = zmq.Poller()
+    for sock in sockets:
+        poller.register(sock, zmq.POLLIN)
+    return poller
+
+
 def is_loopback_host(host: str) -> bool:
     """Shared trust guard for pickled-payload services (graphics client,
     remote forge): one home so loopback policy cannot drift per-module."""

@@ -255,17 +255,17 @@ class ChaosProxy:
     def _loop(self) -> None:
         import zmq
 
+        from znicz_tpu.network_common import bind_with_retry, make_poller
+
         ctx = zmq.Context.instance()
         front = ctx.socket(zmq.ROUTER)  # slaves' REQ sockets connect here
         back = ctx.socket(zmq.DEALER)   # relays to the master's REP
         front.setsockopt(zmq.LINGER, 0)
         back.setsockopt(zmq.LINGER, 0)
-        front.bind(self.front_endpoint)
+        bind_with_retry(front, self.front_endpoint)
         back.connect(self.back_endpoint)
         self._ready.set()
-        poller = zmq.Poller()
-        poller.register(front, zmq.POLLIN)
-        poller.register(back, zmq.POLLIN)
+        poller = make_poller(front, back)
         held: list = []                 # (release_t, seq, out_sock, frames)
         seq = 0
         try:
@@ -467,6 +467,251 @@ def _flood_main(argv: List[str]) -> None:  # pragma: no cover - subprocess
             break
     if driver is not None:
         driver.stop()
+
+
+# -- replica-fleet drivers (ISSUE 12) ------------------------------------------
+
+
+class ReplicaHarness:
+    """Kill/restart driver for a serving replica behind the balancer
+    (the fleet twin of :class:`RelayHarness`): ``make_server`` builds a
+    fresh ``InferenceServer`` each (re)start — at the SAME bind, so the
+    balancer's data DEALER reconnects into the restarted process and
+    its requests ride the existing failover machinery.  ``kill()`` is a
+    simulated replica crash: queued batches, in-flight computes and the
+    retained-previous generation die with it, exactly what a preempted
+    process loses; the restarted replica re-announces with its BOOT
+    snapshot and the balancer heals it back onto the fleet path."""
+
+    def __init__(self, make_server):
+        self.make_server = make_server
+        self.server = None
+        self.kills = 0
+
+    def start(self):
+        self.server = self.make_server()
+        return self.server.start()
+
+    def kill(self) -> None:
+        self.server.stop()
+        self.kills += 1
+
+    def restart(self):
+        """A fresh replica process-equivalent at the same bind."""
+        return self.start()
+
+
+class ScriptedReplica:
+    """Model-free fake replica (ISSUE 12): speaks the replica side of
+    the balancer protocol — ROUTER bind for data traffic, DEALER
+    heartbeats piggybacking readiness/queue-depth/p99 — with a SCRIPTED
+    forward ``y = x * scale`` instead of a jitted model, so fleet
+    failover/hedging/rollback tests pay zero warmup.
+
+    ``snapshots`` maps swap paths to the scale each "generation"
+    computes with — or to a dict ``{"scale": s, "stall_s": t}`` for a
+    generation that is also SLOW (the scripted p99-regression canary);
+    ``swap`` to an unknown path is refused like a broken snapshot, and
+    ``rollback`` restores the retained previous (scale, stall,
+    generation, path) exactly like ``ModelRunner.rollback``.  Fault
+    scripting: ``stall_every``/``stall_s`` sleeps before every Nth
+    reply (the tail the hedger races), ``blackhole`` accepts requests
+    and never answers (the failover path), ``refuse`` answers every
+    infer with that ``(policy, scope)`` refusal.  ``kill()`` stops the
+    thread mid-everything; ``restart()`` comes back at the SAME bind
+    with BOOT state (generation 1, boot scale/path) — a restarted
+    process remembers nothing, which is what the balancer's healing is
+    for.  Scripted state is lock-guarded: tests read counters while the
+    serve thread mutates."""
+
+    def __init__(self, announce: str, replica_id: str,
+                 bind: str = "tcp://127.0.0.1:*",
+                 snapshots: Optional[Dict[str, float]] = None,
+                 boot_path: str = "boot", boot_scale: float = 1.0,
+                 heartbeat_s: float = 0.05, stall_s: float = 0.0,
+                 stall_every: int = 0, blackhole: bool = False,
+                 refuse: Optional[Tuple[str, str]] = None):
+        self.announce = announce
+        self.replica_id = replica_id
+        self.bind = bind
+        self.endpoint: Optional[str] = None
+        self.snapshots = dict(snapshots or {})
+        self.boot_path = boot_path
+        self.boot_scale = float(boot_scale)
+        self.heartbeat_s = float(heartbeat_s)
+        self.stall_s = float(stall_s)
+        self.stall_every = int(stall_every)
+        self.blackhole = blackhole
+        self.refuse = refuse
+        self._lock = threading.Lock()
+        self._reset_state()
+        self.served = 0
+        self.swallowed = 0                  # blackholed requests
+        self.kills = 0
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _reset_state(self) -> None:
+        """Boot state: what a restarted process remembers (nothing)."""
+        self.gen = 1
+        self._hwm = 1
+        self.scale = self.boot_scale
+        self.gen_stall_s = 0.0
+        self.path = self.boot_path
+        self._previous: Optional[Tuple[float, float, int, str]] = None
+
+    def start(self) -> "ScriptedReplica":
+        self._stop = threading.Event()
+        self._ready.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"fake-{self.replica_id}")
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError(f"scripted replica {self.replica_id} "
+                               f"failed to bind {self.bind}")
+        return self
+
+    def kill(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        with self._lock:
+            self.kills += 1
+
+    def restart(self) -> "ScriptedReplica":
+        """Back at the SAME bind with boot state (fresh process)."""
+        if self._thread is not None:
+            self.kill()
+        with self._lock:
+            self._reset_state()
+        self.bind = self.endpoint or self.bind
+        return self.start()
+
+    def _heartbeat(self) -> Dict:
+        with self._lock:
+            return {"cmd": "heartbeat", "replica_id": self.replica_id,
+                    "endpoint": self.endpoint, "ready": True,
+                    "draining": False, "swapping": False,
+                    "gen": self.gen, "snapshot_path": self.path,
+                    "queue_depth": 0, "served": self.served,
+                    "p99_ms_by_bucket": {}}
+
+    def _answer(self, req: Dict) -> Optional[Dict]:
+        """One scripted reply (None = swallow it), state under lock."""
+        cmd = req.get("cmd")
+        rid = req.get("req_id")
+        base = {"req_id": rid, "replica_id": self.replica_id}
+        if cmd == "ping":
+            return dict(base, ok=True, pong=True)
+        if cmd == "swap":
+            path = req.get("path")
+            with self._lock:
+                if path not in self.snapshots:
+                    return dict(base, ok=False,
+                                error=f"unknown snapshot {path!r}")
+                val = self.snapshots[path]
+                if not isinstance(val, dict):
+                    val = {"scale": float(val)}
+                self._previous = (self.scale, self.gen_stall_s,
+                                  self.gen, self.path)
+                self._hwm += 1
+                self.gen = self._hwm
+                self.scale = float(val.get("scale", 1.0))
+                self.gen_stall_s = float(val.get("stall_s", 0.0))
+                self.path = path
+                return dict(base, ok=True, swap_started=True,
+                            generation=self.gen)
+        if cmd == "rollback":
+            with self._lock:
+                if self._previous is None:
+                    return dict(base, ok=False,
+                                error="no previous generation retained")
+                (self.scale, self.gen_stall_s, self.gen,
+                 self.path) = self._previous
+                self._previous = None
+                return dict(base, ok=True, rolled_back=True,
+                            generation=self.gen)
+        if cmd != "infer":
+            return dict(base, ok=False, error=f"unknown cmd {cmd!r}")
+        with self._lock:
+            self.served += 1
+            n = self.served
+            scale, gen = self.scale, self.gen
+        if self.refuse is not None:
+            policy, scope = self.refuse
+            return dict(base, ok=False, rejected=True, policy=policy,
+                        scope=scope, error=f"scripted {policy} refusal")
+        if self.blackhole:
+            with self._lock:
+                self.swallowed += 1
+            return None
+        if self.stall_every and n % self.stall_every == 0:
+            time.sleep(self.stall_s)
+        if self.gen_stall_s:
+            time.sleep(self.gen_stall_s)    # a SLOW generation (the
+            # scripted p99-regression canary)
+        x = np.asarray(req.get("x"), np.float32)
+        return dict(base, ok=True, gen=gen,
+                    y=(x * np.float32(scale)).astype(np.float32))
+
+    def _loop(self) -> None:
+        import zmq
+
+        from znicz_tpu.network_common import bind_with_retry, make_poller
+        from znicz_tpu.parallel import wire
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        sock.setsockopt(zmq.LINGER, 0)
+        bind_with_retry(sock, self.bind)
+        with self._lock:
+            self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+        hb = ctx.socket(zmq.DEALER)
+        hb.setsockopt(zmq.LINGER, 0)
+        hb.connect(self.announce)
+        poller = make_poller(sock, hb)
+        next_hb = 0.0
+        self._ready.set()
+        try:
+            while not self._stop.is_set():
+                now = time.time()
+                if now >= next_hb:
+                    next_hb = now + self.heartbeat_s
+                    frames, _ = wire.encode_message(self._heartbeat())
+                    hb.send_multipart([b""] + frames)
+                if not poller.poll(5):
+                    continue
+                while True:                 # drain heartbeat acks
+                    try:
+                        hb.recv_multipart(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                while True:
+                    try:
+                        raw = sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    envelope, payload = wire.split_envelope(raw)
+                    try:
+                        req, _ = wire.decode_message(payload or raw)
+                    except wire.WireError as exc:
+                        bad, _ = wire.encode_message(
+                            {"ok": False, "bad_frame": True,
+                             "replica_id": self.replica_id,
+                             "error": str(exc)})
+                        sock.send_multipart(list(envelope) + bad)
+                        continue
+                    rep = self._answer(req)
+                    if rep is None:
+                        continue            # blackholed
+                    out, _ = wire.encode_message(rep)
+                    sock.send_multipart(list(envelope) + out,
+                                        copy=False)
+        finally:
+            sock.close(0)
+            hb.close(0)
 
 
 # -- process-level kill harness ------------------------------------------------

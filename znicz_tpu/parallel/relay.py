@@ -864,14 +864,13 @@ class Relay:
         a restarted relay can race its predecessor's port release."""
         import zmq
 
-        from znicz_tpu.network_common import bind_with_retry
+        from znicz_tpu.network_common import bind_with_retry, make_poller
 
         ctx = zmq.Context.instance()
         sock = ctx.socket(zmq.REP)
         bind_with_retry(sock, self.bind)
         self._ready.set()
-        poller = zmq.Poller()
-        poller.register(sock, zmq.POLLIN)
+        poller = make_poller(sock)
         deadline = None
         try:
             while not self._stop.is_set():

@@ -385,6 +385,69 @@ def decode_message(frames: List[bytes]) -> Tuple[Any, Dict[str, Any]]:
     return _unwalk(skeleton), info
 
 
+def peek_message(frames: List[bytes]) -> Dict[str, Any]:
+    """The v3 metadata SKELETON of a multipart message — decoded WITHOUT
+    materializing a single tensor byte (the balancer's routing path:
+    per-request it needs ``cmd``/``req_id``/``deadline_ms``, never the
+    payload, and the whole point of fronting replicas is that the
+    balancer does not decode what it forwards).  Tensor frames are only
+    LENGTH-checked against the manifest, so a corrupted buffer is still
+    refused here rather than forwarded to a replica that would refuse
+    it one hop later.  ndarray leaves appear as :class:`_Slot`
+    placeholders; scalar keys read normally.  Raises :class:`WireError`
+    on anything undecodable (legacy v2 framing included — a peeking
+    peer is a v3-only service)."""
+    if not frames:
+        raise WireError("empty frame stack")
+    head = bytes(frames[0])
+    if not head.startswith(MAGIC):
+        raise WireError(f"no {MAGIC!r} magic — not a v3 message")
+    try:
+        meta = pickle.loads(head[len(MAGIC):])
+        skeleton, manifest = meta["m"], meta["t"]
+    except Exception as exc:
+        raise WireError(f"bad v3 metadata frame: {exc}") from None
+    if not isinstance(skeleton, dict):
+        raise WireError(f"skeleton decodes to "
+                        f"{type(skeleton).__name__}, not a message dict")
+    if len(frames) != 1 + len(manifest):
+        raise WireError(f"manifest lists {len(manifest)} tensors but "
+                        f"{len(frames) - 1} buffer frames arrived")
+    for i, (entry, buf) in enumerate(zip(manifest, frames[1:])):
+        n = buf.nbytes if isinstance(buf, memoryview) else len(buf)
+        if n != entry.get("n"):
+            raise WireError(f"tensor frame {i} is {n} bytes, manifest "
+                            f"says {entry.get('n')}")
+    return skeleton
+
+
+def restamp_message(frames: List[bytes], **keys) -> List[bytes]:
+    """Rewrite top-level skeleton keys of a v3 message WITHOUT touching
+    its tensor frames (they are shared, not copied — the balancer's
+    req_id rewrite and ``lb`` reply stamp ride this).  A key set to
+    None is REMOVED.  The caller is expected to have
+    :func:`peek_message`-validated the stack; undecodable metadata
+    raises :class:`WireError` like everywhere else."""
+    head = bytes(frames[0])
+    if not head.startswith(MAGIC):
+        raise WireError(f"no {MAGIC!r} magic — cannot restamp a "
+                        f"non-v3 message")
+    try:
+        meta = pickle.loads(head[len(MAGIC):])
+        skeleton = meta["m"]
+    except Exception as exc:
+        raise WireError(f"bad v3 metadata frame: {exc}") from None
+    if not isinstance(skeleton, dict):
+        raise WireError("skeleton is not a message dict")
+    for k, v in keys.items():
+        if v is None:
+            skeleton.pop(k, None)
+        else:
+            skeleton[k] = v
+    new_head = MAGIC + pickle.dumps(meta, pickle.HIGHEST_PROTOCOL)
+    return [new_head] + list(frames[1:])
+
+
 class Codec:
     """Stateful message codec: the v3 encode/decode pair PLUS the byte and
     tensor accounting every peer keeps, with no Server/Client instance
@@ -488,6 +551,14 @@ class Codec:
         self._m["bytes_out"].inc(n)
         self._m["messages_out"].inc()
         return frames
+
+    def count_message_in(self, frames: List) -> None:
+        """Inbound accounting for a message that was PEEKED
+        (:func:`peek_message`), not decoded — the balancer's forward
+        path moves frames without materializing tensors, but its
+        byte/message counters must not go dark for it."""
+        self._m["bytes_in"].inc(self.frames_bytes(frames))
+        self._m["messages_in"].inc()
 
     def count_bad_frame(self) -> None:
         """Tick ``bad_frames`` for a request that DECODED but tripped the

@@ -879,14 +879,13 @@ class Server:
         not be orphaned — the slave would block in recv forever)."""
         import zmq
 
-        from znicz_tpu.network_common import bind_with_retry
+        from znicz_tpu.network_common import bind_with_retry, make_poller
 
         ctx = zmq.Context.instance()
         self._stop = False
         self._socket = ctx.socket(zmq.REP)
         bind_with_retry(self._socket, self.endpoint)
-        poller = zmq.Poller()
-        poller.register(self._socket, zmq.POLLIN)
+        poller = make_poller(self._socket)
         deadline = None
         try:
             while not self._stop:

@@ -17,7 +17,13 @@ codec the master/slave stack speaks.
     serving/frontend.py  InferenceServer — ZMQ ROUTER + codec + the
                          overlap compute loop; stats for web_status
     serving/client.py    InferenceClient — DEALER peer, pipelined
-                         submits, resend-on-loss, req_id dedup
+                         submits, resend-on-loss, req_id dedup,
+                         per-endpoint breaker behind a balancer
+    serving/balancer.py  ReplicaBalancer — fleet-grade front over N
+                         replica processes (ISSUE 12): TTL'd heartbeat
+                         membership, least-loaded dispatch,
+                         exactly-once failover, hedged retries, canary
+                         rollover with auto-rollback + healing
 
 Overload safety + live operation (ISSUE 6): per-client token-bucket
 rate limits and deficit-round-robin fair queueing in the batcher
@@ -35,6 +41,7 @@ bench gate: ``python bench.py --serve`` (see README "Serving" and
 "Serving robustness").
 """
 
+from .balancer import ReplicaBalancer                       # noqa: F401
 from .batcher import (AdmissionPolicy, BucketLadder,        # noqa: F401
                       DynamicBatcher, Refusal, Request, TokenBucket)
 from .client import (CircuitOpenError, InferenceClient,     # noqa: F401

@@ -30,6 +30,16 @@ Overload safety (ISSUE 6):
     self-inflicted downtime.  Only the SERVICE-scoped shed (global
     queue at bound) counts as overload.
 
+Behind a balancer the breaker is PER-ENDPOINT (ISSUE 12): a reply that
+carries the balancer's ``lb`` stamp attributes its outcome to the
+``replica_id`` stamped on it — filed into that replica's own rolling
+window (``replica_breakers()``; opens counted) and NOT into the whole-
+service breaker, so one sick replica behind a healthy balancer can
+never fail-fast the client against the whole fleet (the balancer is
+already routing around it).  Unstamped failures — give-ups, timeouts,
+bad frames, and anything from a direct (non-balancer) runner — keep
+feeding the service breaker exactly as before.
+
 Messages ride the wire-v3 codec (parallel/wire.py): the request tensor
 and the result tensor are zero-copy buffer frames.
 """
@@ -109,6 +119,12 @@ class InferenceClient:
         self._brk_backoff = float(breaker_reset_s)
         self._brk_cap = float(breaker_backoff_cap_s)
         self._brk_probe: Optional[int] = None
+        # per-endpoint windows behind a balancer (ISSUE 12): outcome
+        # deques keyed by the reply's replica_id stamp; same window/
+        # threshold as the service breaker, bounded oldest-first
+        self._brk_replicas: "collections.OrderedDict[str, collections.deque]" \
+            = collections.OrderedDict()
+        self._brk_replica_open: Dict[str, bool] = {}
         # telemetry (ISSUE 5): client-side accounting in the registry;
         # historical attribute names preserved by generated properties
         from znicz_tpu import telemetry
@@ -141,7 +157,13 @@ class InferenceClient:
         "breaker_opens": "circuit breaker transitions to open",
         "breaker_short_circuits": "requests refused locally: breaker open",
         "breaker_probes": "half-open probe requests sent",
+        "replica_breaker_opens": "per-endpoint breaker windows opened "
+                                 "(balancer replies, keyed replica_id)",
     }
+
+    #: per-endpoint breaker table bound: oldest-first eviction past
+    #: this many distinct replica_id stamps
+    MAX_REPLICA_BREAKERS = 64
 
     # -- pipelined API ---------------------------------------------------------
 
@@ -204,6 +226,41 @@ class InferenceClient:
         # capped exponential growth, PR 2's reconnect-backoff idiom
         self._brk_backoff = min(self._brk_backoff * 2, self._brk_cap)
         self._m["breaker_opens"].inc()
+
+    def _replica_record(self, replica: str, ok: bool) -> None:
+        """File one lb-stamped outcome into ``replica``'s own window
+        (ISSUE 12).  Purely observational — the balancer routes around
+        a sick replica; the client just must not open its whole-service
+        breaker over it — so there is no admit gate or backoff, only
+        state + an opens counter for the panel."""
+        if self._brk_threshold <= 0:
+            return
+        win = self._brk_replicas.get(replica)
+        if win is None:
+            while len(self._brk_replicas) >= self.MAX_REPLICA_BREAKERS:
+                evicted, _ = self._brk_replicas.popitem(last=False)
+                self._brk_replica_open.pop(evicted, None)
+            win = self._brk_replicas[replica] = collections.deque(
+                maxlen=self._brk_outcomes.maxlen)
+        win.append(bool(ok))
+        was_open = self._brk_replica_open.get(replica, False)
+        now_open = (len(win) >= self._brk_threshold
+                    and win.count(False) >= self._brk_threshold)
+        self._brk_replica_open[replica] = now_open
+        if now_open and not was_open:
+            self._m["replica_breaker_opens"].inc()
+
+    def breaker_state_for(self, replica: str) -> str:
+        """``open``/``closed`` of one replica's per-endpoint window."""
+        return "open" if self._brk_replica_open.get(replica, False) \
+            else "closed"
+
+    def replica_breakers(self) -> Dict[str, Dict]:
+        """Panel snapshot: per-replica window state behind a balancer."""
+        return {r: {"state": "open" if self._brk_replica_open.get(r)
+                    else "closed",
+                    "failures": win.count(False), "window": len(win)}
+                for r, win in self._brk_replicas.items()}
 
     def _breaker_record(self, rid, ok: bool) -> None:
         """File one request OUTCOME.  Breaker failures are service-
@@ -299,10 +356,32 @@ class InferenceClient:
                 # overloaded — a client-scoped shed (this caller's own
                 # fair-share bound) is the caller's problem (module
                 # docstring)
-                self._breaker_record(
-                    rid, bool(rep.get("ok"))
-                    or rep.get("policy") != "shed"
-                    or rep.get("scope") == "client")
+                # breaker failures: service-scoped sheds and the
+                # balancer's terminal failover give-up (every replica
+                # tried and none answered — the fleet is unservable,
+                # exactly what fail-fast exists for); everything else —
+                # ok replies and per-client refusals — is healthy
+                ok = bool(rep.get("ok")) or not (
+                    (rep.get("policy") == "shed"
+                     and rep.get("scope") != "client")
+                    or rep.get("policy") == "failover")
+                replica = rep.get("replica_id")
+                if rep.get("lb") and isinstance(replica, str) \
+                        and rid != self._brk_probe:
+                    # balancer reply: a FAILURE belongs to the stamped
+                    # replica's window, never the whole-service breaker
+                    # (module docstring; the half-open probe is exempt —
+                    # its whole purpose is service reachability).
+                    # Successes ALSO feed the service window: without
+                    # them it would hold only unstamped failures
+                    # (give-ups, bad frames) and a trickle of those over
+                    # hours would open the breaker against a healthy
+                    # fleet that answers everything else fine.
+                    self._replica_record(replica, ok)
+                    if ok:
+                        self._breaker_record(rid, True)
+                else:
+                    self._breaker_record(rid, ok)
             elif rep.get("bad_frame"):
                 # the service could not decode one of OUR requests
                 # (corrupted in flight): a service-path failure for the

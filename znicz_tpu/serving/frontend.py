@@ -63,7 +63,21 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             "request_ttl_s": 5.0, "max_requests": None, "web_port": None,
             "admission": {"enabled": True, "rate_limit": 0.0,
                           "rate_burst": 0.0, "fair": True, "quantum": 0,
-                          "client_queue_bound": 0}}
+                          "client_queue_bound": 0},
+            # replica-fleet balancer knobs (ISSUE 12; serving/
+            # balancer.py reads them through a local alias, like the
+            # admission subtree above): heartbeat cadence + TTL'd
+            # membership, hedged-retry timing, exactly-once failover
+            # budgets, and the canary-rollover verdict thresholds
+            "balance": {"heartbeat_s": 0.25, "replica_ttl_s": 1.5,
+                        "min_replicas": 1, "hedge": True,
+                        "hedge_floor_s": 0.05, "hedge_cap_s": 2.0,
+                        "hedge_p99_mult": 1.5,
+                        "failover_timeout_s": 1.0, "failover_tries": 3,
+                        "park_bound": 256, "canary_fraction": 0.34,
+                        "canary_requests": 30, "canary_p99_mult": 3.0,
+                        "canary_timeout_s": 30.0, "parity_every": 4,
+                        "heal_backoff_s": 30.0}}
 
 
 def _cfg(name: str, override):
@@ -107,10 +121,21 @@ class InferenceServer:
                  ladder: Optional[BucketLadder] = None,
                  max_requests: Optional[int] = None,
                  admission: Optional[AdmissionPolicy] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 announce: Optional[str] = None,
+                 replica_id: Optional[str] = None):
+        import uuid
+
         from znicz_tpu.parallel import wire
 
         self.bind = bind
+        #: fleet membership (ISSUE 12): when set, the router thread
+        #: heartbeats this balancer endpoint with readiness + queue
+        #: depth + per-bucket p99 piggybacked, and every reply carries
+        #: the ``replica_id`` stamp the client's per-endpoint breaker
+        #: keys on
+        self.announce = announce
+        self.replica_id = replica_id or f"replica-{uuid.uuid4().hex[:6]}"
         self.endpoint: Optional[str] = None      # resolved at serve()
         self.runner = ModelRunner(workflow, snapshot=snapshot)
         max_batch = int(_cfg("max_batch", max_batch))
@@ -135,6 +160,19 @@ class InferenceServer:
         self._m_latency = _sc.histogram(
             "request_latency_seconds",
             "e2e request latency (enqueue -> reply handoff)", size=8192)
+        # per-rung latency rings (ISSUE 12): the heartbeat's
+        # p99-by-bucket payload — what the balancer's least-loaded
+        # dispatch and hedge-delay derivation feed on
+        self._m_lat_bucket = {
+            r: _sc.histogram("bucket_latency_seconds",
+                             "request latency per ladder rung "
+                             "(enqueue -> compute done)", size=512,
+                             bucket=str(r))
+            for r in self.batcher.ladder}
+        d_bal = DEFAULTS["balance"]
+        bal = root.common.serving.balance
+        self.heartbeat_s = float(bal.get("heartbeat_s",
+                                         d_bal["heartbeat_s"]))
         self._tracer = telemetry.tracer()
         self.started_at: Optional[float] = None
         self._outbound: "queue.Queue" = queue.Queue()
@@ -160,6 +198,7 @@ class InferenceServer:
         "expired_results": "computed results dropped: deadline passed "
                            "post-compute",
         "serve_errors": "fatal serve-loop failures surfaced to start()",
+        "heartbeats_out": "balancer heartbeats sent (fleet membership)",
     }
 
     # (the historical attribute properties are generated from COUNTERS
@@ -184,9 +223,36 @@ class InferenceServer:
                 "p99_ms": round(float(np.percentile(a, 99)), 3),
                 "mean_ms": round(float(np.mean(a)), 3)}
 
+    def p99_ms_by_bucket(self) -> Dict[int, float]:
+        """``{ladder rung: p99 ms}`` over each rung's recent window —
+        the telemetry the replica piggybacks on every heartbeat."""
+        out: Dict[int, float] = {}
+        for rung, hist in self._m_lat_bucket.items():
+            w = hist.window()
+            if w.size:
+                out[rung] = round(float(np.percentile(w, 99)) * 1e3, 3)
+        return out
+
+    def heartbeat_payload(self) -> Dict:
+        """One heartbeat message (ISSUE 12): membership identity plus
+        the piggybacked ``/readyz`` state, queue depth and per-bucket
+        p99 the balancer's least-loaded dispatch keys on."""
+        return {"cmd": "heartbeat",
+                "replica_id": self.replica_id,
+                "endpoint": self.endpoint,
+                "ready": self.ready(),
+                "draining": self.draining,
+                "swapping": self.runner.swapping,
+                "gen": self.runner.generation,
+                "snapshot_path": self.runner.snapshot_path,
+                "queue_depth": self.batcher.queue_depth,
+                "served": self.served,
+                "p99_ms_by_bucket": self.p99_ms_by_bucket()}
+
     def stats(self) -> Dict:
         """The serving panel / bench record, one dict."""
         out = {"endpoint": self.endpoint,
+               "replica_id": self.replica_id,
                "requests_in": self.requests_in,
                "served": self.served,
                "rejected": self.rejected,
@@ -201,6 +267,9 @@ class InferenceServer:
                "qps": None if self.qps() is None
                else round(self.qps(), 2)}
         out.update(self.latency_quantiles())
+        out["p99_ms_by_bucket"] = self.p99_ms_by_bucket()
+        out["announce"] = self.announce
+        out["heartbeats_out"] = self.heartbeats_out
         out["batcher"] = self.batcher.stats()
         out["model"] = self.runner.stats()
         return out
@@ -307,10 +376,12 @@ class InferenceServer:
     def _serve(self) -> None:
         import zmq
 
+        from znicz_tpu.network_common import bind_with_retry, make_poller
+
         ctx = zmq.Context.instance()
         sock = ctx.socket(zmq.ROUTER)
         sock.setsockopt(zmq.LINGER, 0)
-        sock.bind(self.bind)
+        bind_with_retry(sock, self.bind)
         self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
         # outbound wake-up: the compute thread pokes this inproc pair
         # when it enqueues replies, so a finished batch ships on the
@@ -319,7 +390,16 @@ class InferenceServer:
         self._wake_addr = f"inproc://znicz-serve-wake-{id(self)}"
         wake_r = ctx.socket(zmq.PULL)
         wake_r.setsockopt(zmq.LINGER, 0)
-        wake_r.bind(self._wake_addr)
+        bind_with_retry(wake_r, self._wake_addr)
+        # fleet membership (ISSUE 12): a DEALER to the balancer, owned
+        # by THIS router thread like the codec — heartbeats ride the
+        # poll loop's cadence, acks are drained and discarded
+        hb = None
+        next_hb = 0.0
+        if self.announce:
+            hb = ctx.socket(zmq.DEALER)
+            hb.setsockopt(zmq.LINGER, 0)
+            hb.connect(self.announce)
         if self._warmup:
             # compile every rung BEFORE taking traffic: first-request
             # latency must not eat a compile, and the zero-recompile
@@ -329,9 +409,8 @@ class InferenceServer:
         self._compute_thread = threading.Thread(
             target=self._compute_loop, daemon=True, name="znicz-infer")
         self._compute_thread.start()
-        poller = zmq.Poller()
-        poller.register(sock, zmq.POLLIN)
-        poller.register(wake_r, zmq.POLLIN)
+        poller = make_poller(sock, wake_r) if hb is None \
+            else make_poller(sock, wake_r, hb)
         self._ready.set()
         try:
             while not self._stop.is_set():
@@ -339,12 +418,26 @@ class InferenceServer:
                         self.served + self.timed_out + self.rejected \
                         >= self.max_requests:
                     break
+                if hb is not None:
+                    now = time.perf_counter()
+                    if now >= next_hb:
+                        next_hb = now + self.heartbeat_s
+                        hb.send_multipart(
+                            [b""] + self.codec.encode(
+                                self.heartbeat_payload()), copy=False)
+                        self._m["heartbeats_out"].inc()
                 if poller.poll(5):
                     while True:             # drain queued wake tokens
                         try:
                             wake_r.recv(zmq.NOBLOCK)
                         except zmq.Again:
                             break
+                    if hb is not None:
+                        while True:         # drain heartbeat acks
+                            try:
+                                hb.recv_multipart(zmq.NOBLOCK)
+                            except zmq.Again:
+                                break
                     while True:             # drain every queued message
                         try:
                             frames = sock.recv_multipart(zmq.NOBLOCK)
@@ -359,6 +452,8 @@ class InferenceServer:
             self._drain_outbound(sock)      # flush final replies
             sock.close(0)
             wake_r.close(0)
+            if hb is not None:
+                hb.close(0)
 
     def _drain_outbound(self, sock) -> None:
         n = 0
@@ -400,17 +495,20 @@ class InferenceServer:
                              self.codec.bad_frames + 1)
             sock.send_multipart(
                 list(envelope)
-                + self.codec.refusal(f"bad frame: {exc}", legacy=False))
+                + self.codec.refusal(f"bad frame: {exc}", legacy=False,
+                                     replica_id=self.replica_id))
             return
         cmd = req.get("cmd")
         rid = req.get("req_id")
         if cmd == "ping":
             sock.send_multipart(list(envelope) + self.codec.encode(
-                {"ok": True, "pong": True, "req_id": rid}))
+                {"ok": True, "pong": True, "req_id": rid,
+                 "replica_id": self.replica_id}))
             return
         if cmd == "stats":
             sock.send_multipart(list(envelope) + self.codec.encode(
-                {"ok": True, "stats": self.stats(), "req_id": rid}))
+                {"ok": True, "stats": self.stats(), "req_id": rid,
+                 "replica_id": self.replica_id}))
             return
         if cmd == "swap":
             # zero-downtime rollover trigger (ISSUE 6): load+warm runs
@@ -420,27 +518,48 @@ class InferenceServer:
             if not isinstance(path, str) or not path:
                 sock.send_multipart(list(envelope) + self.codec.encode(
                     {"ok": False, "req_id": rid,
+                     "replica_id": self.replica_id,
                      "error": "swap needs a snapshot 'path'"}))
                 return
             try:
                 self.swap_async(path)
             except RuntimeError as exc:
                 sock.send_multipart(list(envelope) + self.codec.encode(
-                    {"ok": False, "req_id": rid, "error": str(exc)}))
+                    {"ok": False, "req_id": rid,
+                     "replica_id": self.replica_id,
+                     "error": str(exc)}))
                 return
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": True, "swap_started": True, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "generation": self.runner.generation}))
+            return
+        if cmd == "rollback":
+            # fleet canary auto-rollback (ISSUE 12): restore the
+            # retained previous generation — instant and disk-free, so
+            # it runs inline on this router thread (no load, no warm)
+            try:
+                gen = self.runner.rollback()
+            except RuntimeError as exc:
+                sock.send_multipart(list(envelope) + self.codec.encode(
+                    {"ok": False, "req_id": rid,
+                     "replica_id": self.replica_id, "error": str(exc)}))
+                return
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": True, "rolled_back": True, "req_id": rid,
+                 "replica_id": self.replica_id, "generation": gen}))
             return
         if cmd != "infer":
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "error": f"unknown cmd {cmd!r}"}))
             return
         x = req.get("x")
         if not isinstance(x, np.ndarray) or x.ndim < 1:
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "error": "infer request carries no tensor 'x'"}))
             return
         if x.ndim == len(self.runner.sample_shape):
@@ -448,6 +567,7 @@ class InferenceServer:
         if tuple(x.shape[1:]) != self.runner.sample_shape:
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "error": f"sample shape {tuple(x.shape[1:])} != model "
                           f"input {self.runner.sample_shape}"}))
             return
@@ -459,6 +579,7 @@ class InferenceServer:
             # wrong — refuse readably like a wrong shape instead
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "error": f"sample dtype {x.dtype} cannot safely cast "
                           f"to the model's storage dtype "
                           f"{self.runner.dtype}"}))
@@ -493,6 +614,7 @@ class InferenceServer:
             self._m["timed_out"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "timed_out": True, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "policy": "deadline", "trace_id": req.get("trace_id"),
                  "error": f"deadline budget {budget_ms}ms already "
                           f"expended — refused at ingress"}))
@@ -505,6 +627,7 @@ class InferenceServer:
             self._m["rejected"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "rejected": True, "req_id": rid,
+                 "replica_id": self.replica_id,
                  "policy": getattr(reason, "policy", "refused"),
                  "scope": getattr(reason, "scope", "service"),
                  "trace_id": req.get("trace_id"), "error": str(reason)}))
@@ -526,6 +649,7 @@ class InferenceServer:
                 self._m["timed_out"].inc()
                 self._outbound.put((r.reply_to, {
                     "ok": False, "timed_out": True, "req_id": r.req_id,
+                    "replica_id": self.replica_id,
                     "policy": "deadline", "trace_id": r.trace_id,
                     "error": f"request expired before compute (deadline "
                              f"budget spent queueing; ttl cap "
@@ -560,8 +684,16 @@ class InferenceServer:
                 {"rows": sum(r.n for r in live), "requests": len(live),
                  "trace_id": live[0].trace_id if live else None})
         now = time.perf_counter()
+        # per-rung latency ring (ISSUE 12): enqueue -> compute done for
+        # this batch's ladder rung — the heartbeat's p99-by-bucket feed
+        # (histograms carry their own locks; this runs on the compute
+        # thread while the router thread reads)
+        rung = self.batcher.ladder.bucket_for(sum(r.n for r in live)) \
+            if live else None
         off = 0
         for r in live:
+            if rung is not None:
+                self._m_lat_bucket[rung].observe(now - r.t_enqueued)
             if r.t_deadline is not None and now > r.t_deadline:
                 # the post-compute deadline check: a late result is
                 # DROPPED, never shipped — the client already moved on,
@@ -571,6 +703,7 @@ class InferenceServer:
                 self._m["expired_results"].inc()
                 self._outbound.put((r.reply_to, {
                     "ok": False, "timed_out": True, "req_id": r.req_id,
+                    "replica_id": self.replica_id,
                     "policy": "deadline", "trace_id": r.trace_id,
                     "error": "result ready past the deadline — dropped, "
                              "not shipped"}, None))
@@ -583,7 +716,7 @@ class InferenceServer:
             # atomically), the rollover proof's per-reply assertion.
             self._outbound.put((r.reply_to, {
                 "ok": True, "req_id": r.req_id, "trace_id": r.trace_id,
-                "gen": gen,
+                "gen": gen, "replica_id": self.replica_id,
                 "y": np.array(y[off:off + r.n])}, r.t_enqueued))
             off += r.n
             self._m["served"].inc()

@@ -78,6 +78,20 @@ class ModelRunner:
         #: (params tree, generation id) — read ONCE per dispatch, flipped
         #: as one tuple by swap(): per-request snapshot atomicity
         self._active = (self._trainer.extract_params(), 1)
+        #: the snapshot file the LIVE generation came from (boot
+        #: ``snapshot`` arg, updated by swap/rollback) — heartbeats
+        #: carry it so a fleet balancer can heal a restarted replica
+        #: back onto the promoted snapshot
+        self.snapshot_path: str = snapshot or ""
+        #: the RETAINED previous generation ``(params, gen, path)`` —
+        #: set by a successful swap(), consumed by rollback(); costs one
+        #: extra params tree in memory, which is what buys an instant,
+        #: bit-exact, disk-free fleet rollback
+        self._previous: Optional[Tuple] = None
+        #: generation high-water mark: swap always allocates hwm+1, so
+        #: a rollback-then-retry cycle can never hand two DIFFERENT
+        #: param sets the same generation stamp
+        self._gen_hwm = 1
         self._swap_lock = threading.Lock()  # one rollover at a time
         #: True while swap() loads/warms (the /readyz "warming" signal)
         self.swapping = False
@@ -108,7 +122,11 @@ class ModelRunner:
                 "swaps", "completed snapshot rollovers"),
             "swap_failures": _sc.counter(
                 "swap_failures",
-                "rollovers refused/failed (old generation kept serving)")}
+                "rollovers refused/failed (old generation kept serving)"),
+            "rollbacks": _sc.counter(
+                "rollbacks",
+                "retained-previous generation restored (fleet canary "
+                "auto-rollback path)")}
         _sc.gauge("generation", "live snapshot generation id",
                   fn=telemetry.weak_fn(self, lambda r: r.generation))
         compiles = self._m["compiles"]
@@ -136,6 +154,8 @@ class ModelRunner:
         "swaps", "completed snapshot rollovers")
     swap_failures = registered_property(
         "swap_failures", "rollovers refused/failed")
+    rollbacks = registered_property(
+        "rollbacks", "retained-previous generation restored")
 
     @property
     def params(self):
@@ -257,7 +277,14 @@ class ModelRunner:
                     self._maybe_stall()
                     x = np.zeros((rung,) + self.sample_shape, self.dtype)
                     np.asarray(self._fwd(params, jax.device_put(x)))
-                self._active = (params, self.generation + 1)
+                # retain the losing side for a disk-free rollback(); the
+                # hwm (not generation+1) allocates the new id, so a
+                # rolled-back-then-retried rollover never reuses a stamp
+                old_params, old_gen = self._active
+                self._previous = (old_params, old_gen, self.snapshot_path)
+                self._gen_hwm += 1
+                self._active = (params, self._gen_hwm)
+                self.snapshot_path = path
                 self._m["swaps"].inc()
                 return meta
             except Exception:
@@ -265,6 +292,31 @@ class ModelRunner:
                 raise
         finally:
             self.swapping = False
+            self._swap_lock.release()
+
+    def rollback(self) -> int:
+        """Restore the RETAINED previous generation (the fleet canary
+        auto-rollback): an instant, disk-free ``(params, generation)``
+        flip back to exactly the tuple the last :meth:`swap` displaced —
+        bit-exact by construction, generation STAMP restored too, so a
+        rolled-back fleet is indistinguishable from one that never
+        swapped.  One-shot: the retained tuple is consumed.  Raises
+        RuntimeError when nothing is retained or a swap is mid-flight
+        (the live generation is never disturbed either way)."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise RuntimeError("swap in progress — rollback refused")
+        try:
+            if self._previous is None:
+                raise RuntimeError(
+                    "no previous generation retained (nothing was "
+                    "swapped, or it was already rolled back)")
+            params, gen, path = self._previous
+            self._previous = None
+            self._active = (params, gen)
+            self.snapshot_path = path
+            self._m["rollbacks"].inc()
+            return gen
+        finally:
             self._swap_lock.release()
 
     def jit_cache_size(self) -> Optional[int]:
@@ -284,5 +336,8 @@ class ModelRunner:
                 "swapping": self.swapping,
                 "swaps": self.swaps,
                 "swap_failures": self.swap_failures,
+                "rollbacks": self.rollbacks,
+                "snapshot_path": self.snapshot_path,
+                "previous_retained": self._previous is not None,
                 "sample_shape": list(self.sample_shape),
                 "dtype": str(self.dtype)}

@@ -46,6 +46,7 @@ class WebStatus:
         self.relays: List[object] = []      # optional relay nodes (tree)
         self.inference = None               # optional inference service
         self.inference_client = None        # optional breaker-side view
+        self.balancer = None                # optional replica balancer
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -76,6 +77,15 @@ class WebStatus:
         """Show a local InferenceClient's view (ISSUE 6): circuit-
         breaker state, resends/give-ups, in-flight depth."""
         self.inference_client = client
+
+    def register_balancer(self, balancer) -> None:
+        """Show a replica balancer's fleet panel (ISSUE 12): per-
+        replica generation/p99/in-flight/last-heartbeat-age rows, the
+        exactly-once ledger, hedging and rollover state — and make
+        ``/readyz`` answer the FLEET AGGREGATE (``ready_replicas`` /
+        ``total``, 503 below the ``min_replicas`` quorum, mirroring
+        PR 10's training quorum) instead of any single process."""
+        self.balancer = balancer
 
     # -- snapshotting the state (host side, lock-free reads) -------------------
 
@@ -198,6 +208,10 @@ class WebStatus:
             # stats() assembles from plain counters — safe to call from
             # this HTTP thread while the service runs
             out["serving"] = self.inference.stats()
+        if self.balancer is not None:
+            # assembles under the balancer's own lock — safe from this
+            # HTTP thread while the fleet serves
+            out["balancer"] = self.balancer.stats()
         if self.inference_client is not None:
             c = self.inference_client
             out["serving_client"] = {
@@ -210,6 +224,8 @@ class WebStatus:
                 "bad_replies": c.bad_replies,
                 "breaker_opens": c.breaker_opens,
                 "breaker_short_circuits": c.breaker_short_circuits,
+                # per-endpoint windows behind a balancer (ISSUE 12)
+                "replica_breakers": c.replica_breakers(),
             }
         return out
 
@@ -217,16 +233,41 @@ class WebStatus:
         """The ``/healthz`` body: liveness of the registered inference
         service (no service registered = the process itself answers,
         which is liveness enough)."""
+        if self.balancer is not None:
+            return {"ok": bool(self.balancer.alive())}
         inf = self.inference
         alive = True if inf is None else bool(inf.alive())
         return {"ok": alive}
 
     def readiness(self) -> dict:
-        """The ``/readyz`` body: ready iff a registered inference
-        service is up, warmed, not mid-rollover and not draining — or,
-        with only a training MASTER registered (ISSUE 11), iff its
-        elastic quorum is met (503 while degraded is the membership
-        signal an operator's dashboards key on during preemptions)."""
+        """The ``/readyz`` body: with a BALANCER registered (ISSUE 12)
+        the answer is the FLEET AGGREGATE — ``ready_replicas/total``
+        with 503 below the ``min_replicas`` quorum (the old per-process
+        answer said nothing about whether the fleet could serve);
+        otherwise ready iff a registered inference service is up,
+        warmed, not mid-rollover and not draining — or, with only a
+        training MASTER registered (ISSUE 11), iff its elastic quorum
+        is met (503 while degraded is the membership signal an
+        operator's dashboards key on during preemptions)."""
+        bal = self.balancer
+        if bal is not None:
+            ready = bal.ready_count()
+            total = bal.member_count()
+            if not bal.alive():
+                return {"ready": False,
+                        "reason": "dead (balancer loop exited)",
+                        "ready_replicas": ready, "total": total,
+                        "min_replicas": bal.min_replicas}
+            if bal.degraded():
+                return {"ready": False,
+                        "reason": f"degraded: {ready}/{total} replicas "
+                                  f"ready, below the min_replicas "
+                                  f"quorum ({bal.min_replicas})",
+                        "ready_replicas": ready, "total": total,
+                        "min_replicas": bal.min_replicas}
+            return {"ready": True, "reason": "ok",
+                    "ready_replicas": ready, "total": total,
+                    "min_replicas": bal.min_replicas}
         inf = self.inference
         if inf is None:
             srv = self.server
@@ -455,6 +496,56 @@ class WebStatus:
                             f"<th>shed</th></tr>{crows}</table>"
                             "<table border=1><tr><th>bucket</th>"
                             f"<th>hits</th></tr>{brows}</table>")
+                    bal = snap.get("balancer")
+                    if bal:
+                        # the fleet panel (ISSUE 12): one row per
+                        # replica — gen, p99 (top bucket), in-flight,
+                        # last-heartbeat age, rotation state
+                        led = bal["ledger"]
+                        frows = "".join(
+                            f"<tr><td>{html.escape(r['replica_id'])}"
+                            f"{'' if r['in_rotation'] else ' (warming)'}"
+                            f"</td><td>{'ready' if r['ready'] else 'NOT'}"
+                            f"</td><td>{r['gen']}</td>"
+                            f"<td>{max(r['p99_ms_by_bucket'].values()) if r['p99_ms_by_bucket'] else '-'}"
+                            f"</td><td>{r['in_flight']}</td>"
+                            f"<td>{r['last_heartbeat_s']}s ago</td></tr>"
+                            for r in bal["replicas"])
+                        roll = bal.get("rollover")
+                        roll_html = ""
+                        if roll:
+                            roll_html = (
+                                f"<p>rollover: phase {roll['phase']} "
+                                f"-> {html.escape(str(roll['path']))}, "
+                                f"canary {roll['canary']}, samples "
+                                f"{roll['canary_samples']}, parity "
+                                f"mismatches "
+                                f"{roll['parity_mismatches']}</p>")
+                        serving_html += (
+                            "<h2>Replica fleet "
+                            f"{html.escape(str(bal['endpoint']))}</h2>"
+                            f"<p>{'DEGRADED' if bal['degraded'] else 'ok'}"
+                            f": {bal['ready_replicas']}/"
+                            f"{bal['total_replicas']} ready "
+                            f"(quorum {bal['min_replicas']}); ledger "
+                            f"accepted {led['accepted']} = replied "
+                            f"{led['replied']} + refused "
+                            f"{led['refused']} + in-flight "
+                            f"{led['in_flight']} "
+                            f"({'BALANCED' if led['balanced'] else 'LEAK'})"
+                            f"</p><p>failovers: {bal['failovers']}, "
+                            f"hedges: {bal['hedges']} (wins "
+                            f"{bal['hedge_wins']}), dups dropped: "
+                            f"{bal['dup_replies_dropped']}, heals: "
+                            f"{bal['heals']}, rollovers: "
+                            f"{bal['rollovers']}, rollbacks: "
+                            f"{bal['rollbacks']}, hedge delay: "
+                            f"{bal['hedge_delay_ms']} ms</p>"
+                            f"{roll_html}"
+                            "<table border=1><tr><th>replica</th>"
+                            "<th>ready</th><th>gen</th><th>p99 ms</th>"
+                            "<th>in-flight</th><th>heartbeat</th></tr>"
+                            f"{frows}</table>")
                     cli = snap.get("serving_client")
                     if cli:
                         serving_html += (
@@ -464,6 +555,12 @@ class WebStatus:
                             f"{cli['give_ups']}, opens: "
                             f"{cli['breaker_opens']}, short-circuits: "
                             f"{cli['breaker_short_circuits']}</p>")
+                        rb = cli.get("replica_breakers") or {}
+                        if rb:
+                            serving_html += "<p>per-endpoint: " + ", ".join(
+                                f"{html.escape(r)}={s['state']}"
+                                f"({s['failures']}/{s['window']})"
+                                for r, s in sorted(rb.items())) + "</p>"
                     devs = snap["devices"]
                     dev_text = (f"unavailable — {devs['error']}"
                                 if isinstance(devs, dict)
