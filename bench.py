@@ -85,6 +85,17 @@ consistent with the wave, and auto-roll-back a forced
 parity-regression canary with the fleet still serving the old
 generation bit-exactly.
 
+``python bench.py --shard`` gates pod-scale sharded serving
+(znicz_tpu/serving/model.py mesh mode, ISSUE 13) on 8 virtual CPU
+devices in one JSON line: per-device shard shapes exact (rows/dp on
+every data-axis device, staged AND computed), zero recompiles across a
+mixed-size stream on the dp-snapped ladder, per-rung parity vs the
+single-device reference (tight numerical band — reduction tiling is
+layout-dependent; 0 ULP batch-independence WITHIN each mesh), the
+default 1x1 config byte-identical to single-device serving, and a
+{data:4}-vs-{data:2,model:2} layout comparison (recorded; TPU protocol
+in BASELINE.md).
+
 ``python bench.py --telemetry`` gates the unified telemetry layer
 (znicz_tpu/telemetry/, ISSUE 5): interleaved enabled/disabled best-of
 windows of the real fused training loop; FAILS if spans + hot-loop
@@ -1995,6 +2006,208 @@ def fleet_main() -> None:
         raise SystemExit("fleet gates failed: " + "; ".join(failures))
 
 
+#: --shard protocol knobs (ISSUE 13): the pod-scale sharded-serving
+#: gates, run on 8 VIRTUAL CPU devices (znicz_tpu/virtdev.py — the same
+#: provisioning conftest/the MULTICHIP dryruns use), so they hold on
+#: this TPU-less container and verify STRUCTURE: exact per-device shard
+#: shapes, jit-cache hygiene, parity.  Throughput across layouts is
+#: recorded but NOT gated — 8 virtual devices time-slice one throttled
+#: core, so layout timing here is scheduling noise; the real-TPU
+#: protocol lives in BASELINE.md.  The model is the 2048-hidden MNIST
+#: MLP (the --serve model): wide enough that the ``model`` axis engages
+#: (FusedTrainer.tp_threshold = 1024) and that gemm reduction tiling is
+#: genuinely layout-dependent — which is WHY cross-layout parity is a
+#: tight numerical band, not 0 ULP: XLA's reduction order changes with
+#: the per-device operand shape, the same reason PR 4 pinned the 0-ULP
+#: contract per bucket executable.  WITHIN a fixed mesh the 0-ULP
+#: batch-independence contract is gated bit-exactly.
+SHARD_DEVICES = 8
+SHARD_MAX_BATCH = 32
+SHARD_HIDDEN = SERVE_HIDDEN
+#: cross-layout parity band: max |y_layout - y_single| over a rung,
+#: relative to max |y_single| (measured here: ~5e-7..1.1e-6 — f32
+#: reduction-order noise over the K=784/2048 contractions; the band
+#: leaves ~10x headroom while still failing any real math divergence,
+#: which would show up orders of magnitude larger)
+SHARD_PARITY_REL = 1e-5
+SHARD_LAYOUTS = (("d4", (4, 1)), ("d2m2", (2, 2)))
+SHARD_MIXED_SIZES = (1, 2, 3, 5, 8, 13, 21, 32, 7, 2, 30, 16, 4)
+SHARD_WINDOW_S = 1.0        # per-layout closed-loop timing window
+
+
+def shard_main() -> None:
+    """``--shard``: the sharded-serving gates (ISSUE 13), one JSON
+    line.  Against the SAME workflow, a single-device reference runner
+    and one mesh-native runner per layout in ``SHARD_LAYOUTS``:
+
+      - **shard shapes**: for every ladder rung, the staged batch and
+        the computed result both hold EXACTLY rows/dp rows on each of
+        the dp data-axis devices (``addressable_shards``) — the "no
+        gather through device 0" placement proof;
+      - **jit hygiene**: warmup compiles exactly one executable per
+        rung; a mixed-size request stream (sizes 1..max_batch, padded
+        by the dp-snapped ladder) causes ZERO recompiles, by the trace
+        counter AND jax's own pjit cache size;
+      - **parity**: per rung, the sharded result matches the
+        single-device reference within ``SHARD_PARITY_REL`` (see the
+        knob comment for why cross-LAYOUT is a band), and the 0-ULP
+        batch-independence contract (offset/neighbor/pad independence)
+        holds bit-exactly WITHIN each mesh;
+      - **mesh 1x1**: a runner built under the default mesh config IS
+        the single-device path — results byte-identical to the
+        reference runner, rung by rung;
+      - **layouts**: {data:4} vs {data:2,model:2} rows/s recorded (not
+        gated on this host — see the knob comment).
+
+    Gates are enforced AFTER the JSON line so a tripped gate never
+    destroys the measurement record it complains about."""
+    import time as _time
+
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    # BEFORE the first backend init (conftest discipline): this gate
+    # verifies sharding STRUCTURE, which needs >= 8 devices regardless
+    # of what hardware the host has
+    provision_cpu_devices(SHARD_DEVICES)
+
+    from znicz_tpu.parallel.mesh import make_mesh
+    from znicz_tpu.serving import BucketLadder, ModelRunner
+
+    wf = _build_serve_workflow()
+    sample_shape = tuple(int(d) for d in wf.forwards[0].input.shape[1:])
+    rng = np.random.default_rng(1013)
+
+    def pad(x, b):
+        out = np.zeros((b,) + x.shape[1:], np.float32)
+        out[:len(x)] = x
+        return out
+
+    # single-device reference: per-rung probe outputs
+    ref = ModelRunner(wf)
+    ref_ladder = BucketLadder(SHARD_MAX_BATCH)
+    ref.warmup(ref_ladder)
+    probes = {r: rng.normal(0, 1, (r,) + sample_shape).astype(np.float32)
+              for r in BucketLadder(SHARD_MAX_BATCH, dp=max(
+                  dp for _, (dp, _mp) in SHARD_LAYOUTS))}
+    ref_y = {r: ref.infer(pad(probes[r], ref_ladder.bucket_for(r)))[:r]
+             for r in probes}
+
+    failures = []
+    layouts = {}
+    for tag, (dp, mp) in SHARD_LAYOUTS:
+        runner = ModelRunner(
+            wf, mesh=make_mesh((dp, mp), ("data", "model")))
+        ladder = BucketLadder(SHARD_MAX_BATCH, dp=dp)
+        if any(r % dp for r in ladder.rungs):
+            failures.append(f"{tag}: ladder {ladder.rungs} not snapped "
+                            f"to dp={dp}")
+        warm = runner.warmup(ladder)
+        rec = {"mesh": runner.mesh_shape, "devices": runner.device_count,
+               "ladder": list(ladder.rungs), "compiles_warm": warm,
+               "parity_rel": 0.0}
+        # shard shapes + parity, rung by rung
+        for rung in ladder:
+            staged = runner.stage(pad(probes[rung]
+                                      if rung in probes else
+                                      rng.normal(0, 1, (rung,)
+                                                 + sample_shape
+                                                 ).astype(np.float32),
+                                      rung))
+            x_shards = [s.data.shape for s in staged.addressable_shards]
+            y_dev, _gen = runner.infer_staged(staged)
+            y_shards = [s.data.shape for s in y_dev.addressable_shards]
+            want = rung // dp
+            if (len(x_shards) != runner.device_count
+                    or any(s[0] != want for s in x_shards)):
+                failures.append(f"{tag}: rung {rung} staged shards "
+                                f"{x_shards}, want {want} rows on each "
+                                f"of {runner.device_count} devices")
+            if any(s[0] != want for s in y_shards):
+                failures.append(f"{tag}: rung {rung} result shards "
+                                f"{y_shards}, want {want} rows each")
+            if rung in probes:
+                y = np.asarray(y_dev)[:rung]
+                rel = float(np.max(np.abs(y - ref_y[rung]))
+                            / max(np.max(np.abs(ref_y[rung])), 1e-30))
+                rec["parity_rel"] = max(rec["parity_rel"], rel)
+                if rel > SHARD_PARITY_REL:
+                    failures.append(
+                        f"{tag}: rung {rung} sharded-vs-single-device "
+                        f"parity {rel:.2e} > {SHARD_PARITY_REL}")
+        # 0-ULP batch-independence WITHIN this mesh: coalesced vs
+        # alone-in-the-rung, plus garbage pad rows
+        rung = ladder.rungs[min(1, len(ladder.rungs) - 1)]
+        parts = [probes[rung][:rung // 2], probes[rung][rung // 2:]]
+        alone = [runner.infer(pad(p, rung))[:len(p)] for p in parts]
+        together = runner.infer(np.concatenate(parts))
+        garbage = pad(parts[0], rung)
+        garbage[len(parts[0]):] = 1e9
+        if not (np.array_equal(together[:len(parts[0])], alone[0])
+                and np.array_equal(together[len(parts[0]):], alone[1])
+                and np.array_equal(
+                    runner.infer(garbage)[:len(parts[0])], alone[0])):
+            failures.append(f"{tag}: 0-ULP batch-independence broke "
+                            f"on the sharded path (rung {rung})")
+        # mixed-size stream: zero recompiles after warmup
+        c0, j0 = runner.compiles, runner.jit_cache_size()
+        for n in SHARD_MIXED_SIZES:
+            runner.infer(pad(probes.get(
+                n, rng.normal(0, 1, (n,) + sample_shape
+                              ).astype(np.float32))[:n],
+                ladder.bucket_for(n)))
+        rec["recompiles_mixed_stream"] = runner.compiles - c0
+        rec["jit_cache_size"] = runner.jit_cache_size()
+        if runner.compiles != c0:
+            failures.append(f"{tag}: {runner.compiles - c0} recompiles "
+                            f"during the mixed-size stream")
+        if j0 is not None and runner.jit_cache_size() != j0:
+            failures.append(f"{tag}: jax jit cache grew "
+                            f"{j0} -> {runner.jit_cache_size()} during "
+                            f"the mixed-size stream")
+        # layout timing (recorded, not gated on this host)
+        xb = probes[SHARD_MAX_BATCH]
+        rows = 0
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < SHARD_WINDOW_S:
+            runner.infer(xb)
+            rows += SHARD_MAX_BATCH
+        rec["rows_per_s"] = round(rows / (_time.perf_counter() - t0), 1)
+        rec["stage_copies"] = runner.stage_copies
+        layouts[tag] = rec
+
+    # mesh 1x1 (default config) must BE the single-device path
+    one = ModelRunner(wf)       # mesh_from_config() -> None by default
+    one.warmup(ref_ladder)
+    one_exact = all(
+        np.array_equal(one.infer(pad(probes[r],
+                                     ref_ladder.bucket_for(r)))[:r],
+                       ref_y[r]) for r in probes)
+    if one.mesh is not None:
+        failures.append("default mesh config did not resolve to the "
+                        "single-device path")
+    if not one_exact:
+        failures.append("mesh 1x1 results differ from the single-device "
+                        "reference (must be byte-identical)")
+
+    print(json.dumps({
+        "metric": "serving_sharded_structure",
+        "value": max(rec["parity_rel"] for rec in layouts.values()),
+        "unit": "max_rel_parity_vs_single_device",
+        "devices_provisioned": SHARD_DEVICES,
+        "hidden_width": SHARD_HIDDEN,
+        "max_batch": SHARD_MAX_BATCH,
+        "parity_band": SHARD_PARITY_REL,
+        "mesh_1x1_byte_identical": bool(one_exact),
+        "layouts": layouts,
+        "single_device_rows_per_s": None,   # see layouts: CPU timing
+        #                                     noise — BASELINE.md r18
+        #                                     carries the TPU protocol
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    if failures:
+        raise SystemExit("shard gates failed: " + "; ".join(failures))
+
+
 #: --telemetry protocol knobs (ISSUE 5).  Same de-flake discipline as
 #: --serve / the PR-4 snapshot guard: enabled/disabled windows are
 #: INTERLEAVED (this container's cgroup CPU share swings minute to
@@ -2424,6 +2637,8 @@ if __name__ == "__main__":
         serve_main()
     elif "--fleet" in args:
         fleet_main()
+    elif "--shard" in args:
+        shard_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

@@ -133,6 +133,21 @@ class Launcher:
                                  "--snapshot; default bind tcp://*:5580; "
                                  "knobs: root.common.serving.max_batch/"
                                  "max_delay_ms/queue_bound)")
+        parser.add_argument("--mesh-data", type=int, default=None,
+                            metavar="N",
+                            help="with --serve: data-axis size of the "
+                                 "serving mesh (root.common.serving."
+                                 "mesh.data, default 1) — each request "
+                                 "batch splits into N row shards, one "
+                                 "per device (ISSUE 13).  With "
+                                 "--backend cpu, N x --mesh-model "
+                                 "virtual devices are provisioned")
+        parser.add_argument("--mesh-model", type=int, default=None,
+                            metavar="N",
+                            help="with --serve: model-axis size of the "
+                                 "serving mesh (root.common.serving."
+                                 "mesh.model, default 1) — wide FC "
+                                 "layers column-shard over N devices")
         parser.add_argument("--announce", default=None,
                             metavar="BALANCER",
                             help="with --serve: heartbeat this replica "
@@ -193,6 +208,10 @@ class Launcher:
         if args.min_replicas is not None:
             root.common.serving.balance.min_replicas = \
                 int(args.min_replicas)
+        if args.mesh_data is not None:
+            root.common.serving.mesh.data = int(args.mesh_data)
+        if args.mesh_model is not None:
+            root.common.serving.mesh.model = int(args.mesh_model)
         if args.plan_tree is not None:
             return self._plan_tree(args)
         if args.balance is not None:
@@ -223,10 +242,13 @@ class Launcher:
             if args.backend == "cpu":
                 # must happen BEFORE the first jax backend init; on hosts
                 # with the axon plugin, env vars alone cannot unpin the
-                # platform (znicz_tpu/virtdev.py)
+                # platform (znicz_tpu/virtdev.py).  A serving mesh on a
+                # CPU host needs dp x mp VIRTUAL devices (ISSUE 13)
                 from znicz_tpu.virtdev import provision_cpu_devices
 
-                provision_cpu_devices(1, verify=False)
+                provision_cpu_devices(
+                    max(1, (args.mesh_data or 1)
+                        * (args.mesh_model or 1)), verify=False)
         if args.fused:
             root.common.engine.fused = True
         if args.master is not None and args.slave is not None:
@@ -261,6 +283,18 @@ class Launcher:
             _load_module(args.config, "znicz_tpu._user_config")
         if args.overrides:
             apply_overrides(root, args.overrides)
+        # a serving mesh may also arrive via the config file or dotted
+        # overrides (not just the --mesh-* flags read above): now that
+        # both are applied, re-raise the CPU virtual-device count if
+        # the configured mesh needs more — still before the first jax
+        # backend init, and provision only ever raises the count
+        if args.backend == "cpu" and args.serve is not None:
+            mc = root.common.serving.mesh
+            need = int(mc.get("data", 1)) * int(mc.get("model", 1))
+            if need > 1:
+                from znicz_tpu.virtdev import provision_cpu_devices
+
+                provision_cpu_devices(need, verify=False)
         # XLA scheduler flags must land in the env BEFORE the workflow
         # module's first jax backend init (ISSUE 7: the latency-hiding
         # scheduler is the compiler half of ingest/compute overlap;

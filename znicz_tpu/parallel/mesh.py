@@ -43,8 +43,16 @@ def make_mesh(shape: Optional[Tuple[int, ...]] = None,
         shape = (len(devs),) + (1,) * (len(axes) - 1)
     n = int(np.prod(shape))
     if n > len(devs):
-        raise ValueError(f"mesh shape {shape} needs {n} devices, "
-                         f"have {len(devs)}")
+        # a readable refusal instead of the raw XLA reshape failure:
+        # on a CPU host the fix is virtual devices, and the operator
+        # needs to know that BEFORE the first backend init
+        raise ValueError(
+            f"mesh shape {dict(zip(axes, shape))} needs {n} devices, "
+            f"but jax sees only {len(devs)} "
+            f"({jax.default_backend()} backend).  On a CPU host, "
+            f"provision virtual devices BEFORE the first jax backend "
+            f"init: znicz_tpu.virtdev.provision_cpu_devices({n}) or "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
     grid = np.asarray(devs[:n]).reshape(shape)
     return Mesh(grid, tuple(axes))
 

@@ -13,7 +13,12 @@ codec the master/slave stack speaks.
                          cache), bounded-queue backpressure
     serving/model.py     ModelRunner — frozen params, bucketed jit
                          cache with compile counters, donated
-                         ping-pong stage/infer halves
+                         ping-pong stage/infer halves; mesh-native
+                         (ISSUE 13): root.common.serving.mesh.* builds
+                         a NamedSharding mesh, params replicate or
+                         column-shard per FusedTrainer.param_sharding,
+                         request batches split rows/dp over the data
+                         axis directly from the host
     serving/frontend.py  InferenceServer — ZMQ ROUTER + codec + the
                          overlap compute loop; stats for web_status
     serving/client.py    InferenceClient — DEALER peer, pipelined
@@ -35,7 +40,8 @@ command / SIGHUP; every reply carries its snapshot ``gen``) with
 ``/healthz``/``/readyz`` on web_status.
 
 Config home: ``root.common.serving.{max_batch, max_delay_ms,
-queue_bound, request_ttl_s}`` + ``root.common.serving.admission.*``;
+queue_bound, request_ttl_s}`` + ``root.common.serving.admission.*``
++ ``root.common.serving.mesh.*`` (pod-slice sharding, ISSUE 13);
 CLI: ``python -m znicz_tpu <workflow> --serve [BIND] --snapshot FILE``;
 bench gate: ``python bench.py --serve`` (see README "Serving" and
 "Serving robustness").

@@ -264,6 +264,8 @@ class ReplicaBalancer:
                  "swapping": m["swapping"],
                  "snapshot_path": m["snapshot_path"],
                  "in_rotation": rid not in self._rotation_out(),
+                 "device_count": m.get("device_count", 1),
+                 "mesh": m.get("mesh"),
                  "p99_ms_by_bucket": dict(m["p99_ms_by_bucket"])}
                 for rid, m in sorted(self._members.items())]
             roll = None
@@ -523,6 +525,12 @@ class ReplicaBalancer:
                 "swapping": bool(skel.get("swapping")),
                 "draining": bool(skel.get("draining")),
                 "snapshot_path": skel.get("snapshot_path") or "",
+                # capacity (ISSUE 13): a pod-slice replica advertises
+                # its mesh; pre-mesh replicas beat without it -> 1
+                "device_count": max(1, int(skel.get("device_count")
+                                           or 1)),
+                "mesh": skel.get("mesh") if isinstance(
+                    skel.get("mesh"), dict) else None,
                 "p99_ms_by_bucket": dict(
                     skel.get("p99_ms_by_bucket") or {}),
             }
@@ -566,8 +574,11 @@ class ReplicaBalancer:
 
     def _candidates(self, exclude=()) -> List[str]:
         """Ready, in-rotation members, least-loaded first (heartbeat
-        queue depth + balancer-tracked in-flight; round-robin
-        tie-break).  Lock held."""
+        queue depth + balancer-tracked in-flight, NORMALIZED by the
+        replica's advertised device count — an 8-chip pod slice drains
+        8x the rows of a 1-chip replica, so equal raw queue depths do
+        not mean equal wait; ISSUE 13); round-robin tie-break.  Lock
+        held."""
         out = []
         stale = []
         rotation_out = self._rotation_out()
@@ -576,7 +587,9 @@ class ReplicaBalancer:
         for rid, m in self._members.items():
             if not m["ready"] or rid in exclude or rid in rotation_out:
                 continue
-            load = m["queue_depth"] + self._dispatch_counts.get(rid, 0)
+            load = (m["queue_depth"]
+                    + self._dispatch_counts.get(rid, 0)) \
+                / m.get("device_count", 1)
             if heal_gate and m["snapshot_path"] != self._fleet_path:
                 # awaiting heal: it would answer with stale params and
                 # an off-wave generation stamp — last resort only

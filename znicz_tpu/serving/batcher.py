@@ -52,12 +52,29 @@ class BucketLadder:
     """The fixed ladder of padded batch sizes.  Default rungs are the
     powers of two up to ``max_batch`` (plus ``max_batch`` itself when it
     is not a power of two) — a ladder that over-pads by at most 2x while
-    keeping the executable count logarithmic in ``max_batch``."""
+    keeping the executable count logarithmic in ``max_batch``.
 
-    def __init__(self, max_batch: int, rungs: Optional[Sequence[int]] = None):
+    ``dp`` is the serving mesh's data-axis size (ISSUE 13): every rung
+    must split evenly across the data-parallel devices, so default
+    rungs are SNAPPED UP to the next multiple of ``dp`` (then deduped —
+    the ladder only ever gets shorter) and explicit rungs that do not
+    divide are refused readably rather than discovered as an XLA
+    sharding error at the first request."""
+
+    def __init__(self, max_batch: int, rungs: Optional[Sequence[int]] = None,
+                 dp: int = 1):
         self.max_batch = int(max_batch)
+        self.dp = int(dp)
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if self.dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        if self.max_batch % self.dp:
+            raise ValueError(
+                f"max_batch={self.max_batch} does not divide across the "
+                f"mesh's data axis (dp={self.dp}); pick a max_batch "
+                f"that is a multiple of dp")
+        snapped = rungs is None
         if rungs is None:
             rungs = []
             r = 1
@@ -65,11 +82,21 @@ class BucketLadder:
                 rungs.append(r)
                 r *= 2
             rungs.append(self.max_batch)
+            # mesh-aware snap: each rung up to the next multiple of dp
+            rungs = [-(-r // self.dp) * self.dp for r in rungs]
         rungs = sorted(set(int(r) for r in rungs))
         if not rungs or rungs[0] < 1 or rungs[-1] != self.max_batch:
             raise ValueError(
                 f"bucket ladder {rungs} must be positive and end at "
                 f"max_batch={self.max_batch}")
+        if not snapped:
+            bad = [r for r in rungs if r % self.dp]
+            if bad:
+                raise ValueError(
+                    f"bucket ladder rungs {bad} do not divide across "
+                    f"the mesh's data axis (dp={self.dp}); every rung "
+                    f"must be a multiple of dp so each device holds "
+                    f"exactly rows/dp rows")
         self.rungs: List[int] = rungs
 
     def bucket_for(self, n: int) -> int:

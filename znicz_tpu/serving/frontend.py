@@ -61,6 +61,11 @@ from .model import ModelRunner
 #: test_no_adhoc_counters.py lints for silently-ignored config.
 DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             "request_ttl_s": 5.0, "max_requests": None, "web_port": None,
+            # serving mesh (ISSUE 13; serving/model.py reads it through
+            # a local alias): NamedSharding axis sizes — requests split
+            # over ``data``, wide FC tails column-shard over ``model``.
+            # 1x1 = the single-device path, bit-exact
+            "mesh": {"data": 1, "model": 1},
             "admission": {"enabled": True, "rate_limit": 0.0,
                           "rate_burst": 0.0, "fair": True, "quantum": 0,
                           "client_queue_bound": 0},
@@ -139,6 +144,17 @@ class InferenceServer:
         self.endpoint: Optional[str] = None      # resolved at serve()
         self.runner = ModelRunner(workflow, snapshot=snapshot)
         max_batch = int(_cfg("max_batch", max_batch))
+        # mesh-aware ladder (ISSUE 13): default rungs snap to multiples
+        # of the data-axis size so every batch splits evenly; an
+        # explicit ladder that cannot split is refused HERE, readably,
+        # not as an XLA sharding error at the first request
+        dp = self.runner.data_parallel
+        if ladder is None:
+            ladder = BucketLadder(max_batch, dp=dp)
+        elif dp > 1 and ladder.dp != dp:
+            # re-validate an explicit ladder against THIS runner's mesh
+            # through the one home of the divisibility check/message
+            ladder = BucketLadder(ladder.max_batch, ladder.rungs, dp=dp)
         self.batcher = DynamicBatcher(
             max_batch=max_batch,
             max_delay_ms=float(_cfg("max_delay_ms", max_delay_ms)),
@@ -247,6 +263,11 @@ class InferenceServer:
                 "snapshot_path": self.runner.snapshot_path,
                 "queue_depth": self.batcher.queue_depth,
                 "served": self.served,
+                # capacity (ISSUE 13): the balancer normalizes its
+                # least-loaded score by device_count so a 1-chip and an
+                # 8-chip replica stop drawing equal traffic
+                "device_count": self.runner.device_count,
+                "mesh": self.runner.mesh_shape,
                 "p99_ms_by_bucket": self.p99_ms_by_bucket()}
 
     def stats(self) -> Dict:

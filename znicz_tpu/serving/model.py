@@ -34,6 +34,25 @@ on the old generation throughout, and because every dispatch reads the
 tuple exactly once, every request is answered entirely by one snapshot
 generation (the ``gen`` id in each reply proves it).  A failed load or
 warm leaves the served generation untouched.
+
+**Pod-scale sharding** (ISSUE 13): with ``root.common.serving.mesh.*``
+set (``data``/``model`` axis sizes; default 1x1 = exactly the
+single-device path above), the runner goes mesh-native: params are
+replicated (or column-sharded over ``model`` for wide FC layers) via
+``FusedTrainer.param_sharding`` + ``mesh.global_put``, the forward is
+jitted with explicit ``in_shardings``/``out_shardings``, and every
+staged batch is split along the ``data`` axis — each device holds
+exactly ``rows/dp`` rows, placed DIRECTLY from the host (one transfer
+per device shard, never a gather through device 0).  The bucket
+ladder's rungs are snapped to multiples of ``dp`` so every executable
+splits evenly, which keeps the jit cache bounded and the
+zero-recompile contract intact on the sharded path.  The 0-ULP
+batch-independence contract extends UNCHANGED to a fixed mesh (a
+request's rows are a pure function of its rows + the rung executable,
+wherever its rows land across devices); across DIFFERENT mesh layouts
+results agree only numerically — reduction tiling is layout-dependent,
+the same reason PR 4 pinned parity per bucket executable
+(bench.py --shard gates the band; tests/test_shard_serving.py).
 """
 
 from __future__ import annotations
@@ -44,7 +63,26 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from znicz_tpu.core.config import root
 from znicz_tpu.telemetry.metrics import registered_property
+
+
+def mesh_from_config():
+    """The serving mesh per ``root.common.serving.mesh.*`` (read
+    through a local alias so the config-knob lint tracks the keys), or
+    None for the default 1x1 — which keeps the runner on the exact
+    single-device code path (bit-for-bit today's behavior)."""
+    mc = root.common.serving.mesh
+    dp = int(mc.get("data", 1))
+    mp = int(mc.get("model", 1))
+    if dp < 1 or mp < 1:
+        raise ValueError(f"serving mesh axes must be >= 1, got "
+                         f"data={dp} model={mp}")
+    if dp * mp == 1:
+        return None
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    return make_mesh((dp, mp), ("data", "model"))
 
 
 class ModelRunner:
@@ -56,7 +94,7 @@ class ModelRunner:
     reconstruction for MSE heads."""
 
     def __init__(self, workflow, snapshot: str = "",
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None, mesh=None):
         import jax
 
         from znicz_tpu.parallel.fused import FusedTrainer
@@ -74,10 +112,21 @@ class ModelRunner:
 
             snapshotter.load_inference(workflow, snapshot)
         self.workflow = workflow
-        self._trainer = FusedTrainer(workflow)
+        #: the serving mesh (ISSUE 13): explicit arg wins, else
+        #: ``root.common.serving.mesh.*``; None = single-device (the
+        #: pre-mesh code path, bit-exact)
+        self.mesh = mesh if mesh is not None else mesh_from_config()
+        self._trainer = FusedTrainer(workflow, mesh=self.mesh)
+        if self.mesh is not None:
+            from znicz_tpu.parallel.mesh import data_sharding
+
+            self._data_sharding = data_sharding(self.mesh)
+        else:
+            self._data_sharding = None
         #: (params tree, generation id) — read ONCE per dispatch, flipped
         #: as one tuple by swap(): per-request snapshot atomicity
-        self._active = (self._trainer.extract_params(), 1)
+        self._active = (self._place_params(
+            self._trainer.extract_params()), 1)
         #: the snapshot file the LIVE generation came from (boot
         #: ``snapshot`` arg, updated by swap/rollback) — heartbeats
         #: carry it so a fleet balancer can heal a restarted replica
@@ -126,9 +175,18 @@ class ModelRunner:
             "rollbacks": _sc.counter(
                 "rollbacks",
                 "retained-previous generation restored (fleet canary "
-                "auto-rollback path)")}
+                "auto-rollback path)"),
+            "stage_copies": _sc.counter(
+                "stage_copies",
+                "host batches copied before staging (non-contiguous or "
+                "wrong-dtype input; the frontend's assemble path never "
+                "pays this)")}
         _sc.gauge("generation", "live snapshot generation id",
                   fn=telemetry.weak_fn(self, lambda r: r.generation))
+        _sc.gauge("mesh_devices", "devices in the serving mesh (1 = "
+                  "single-device)",
+                  fn=telemetry.weak_fn(self, lambda r: r.device_count))
+        self._tracer = telemetry.tracer()
         compiles = self._m["compiles"]
         key = self._trainer._key0       # eval path never consumes it
 
@@ -139,8 +197,20 @@ class ModelRunner:
             t = self._trainer
             return t.forward_pass(params, t._decode(x), key, train=False)
 
-        self._fwd = jax.jit(fwd, donate_argnums=(1,) if self.donate
-                            else ())
+        donate = (1,) if self.donate else ()
+        if self.mesh is None:
+            self._fwd = jax.jit(fwd, donate_argnums=donate)
+        else:
+            # explicit shardings (SNIPPETS [3]): params pinned to their
+            # param_sharding placements, the batch split over ``data``
+            # in AND out — GSPMD propagates through the forward and
+            # inserts the model-axis collectives where column-sharded
+            # FC weights demand them
+            self._fwd = jax.jit(
+                fwd, donate_argnums=donate,
+                in_shardings=(self._param_shardings(self.params),
+                              self._data_sharding),
+                out_shardings=self._data_sharding)
         # weak_fn: the process-global registry must not pin this
         # runner's jitted executables + device params after the service
         # drops it (a dead ref renders NaN)
@@ -156,6 +226,8 @@ class ModelRunner:
         "swap_failures", "rollovers refused/failed")
     rollbacks = registered_property(
         "rollbacks", "retained-previous generation restored")
+    stage_copies = registered_property(
+        "stage_copies", "host batches copied before staging")
 
     @property
     def params(self):
@@ -168,15 +240,86 @@ class ModelRunner:
         completed :meth:`swap`."""
         return self._active[1]
 
+    # -- mesh placement (ISSUE 13) ---------------------------------------------
+
+    @property
+    def device_count(self) -> int:
+        """Devices this runner computes on (the mesh size; 1 when
+        single-device) — piggybacked on fleet heartbeats so the
+        balancer can weight dispatch by capacity."""
+        return 1 if self.mesh is None else int(self.mesh.size)
+
+    @property
+    def data_parallel(self) -> int:
+        """The mesh's ``data``-axis size (1 when single-device): every
+        ladder rung must be a multiple of this."""
+        return 1 if self.mesh is None else int(self.mesh.shape["data"])
+
+    @property
+    def mesh_shape(self) -> Optional[Dict[str, int]]:
+        """``{"data": dp, "model": mp}`` (None when single-device) —
+        the heartbeat/panel form of the mesh."""
+        if self.mesh is None:
+            return None
+        return {str(a): int(self.mesh.shape[a])
+                for a in self.mesh.axis_names}
+
+    def _param_shardings(self, params):
+        """The params tree's NamedSharding tree: replicated, or
+        column-sharded over ``model`` where ``param_sharding`` applies
+        (wide FC weights).  Mesh-mode only."""
+        return {name: {k: self._trainer.param_sharding(name, k, a)
+                       for k, a in layer.items()}
+                for name, layer in params.items()}
+
+    def _place_params(self, params):
+        """Distribute a params tree onto the mesh per its shardings
+        (``global_put``: each process contributes only the shards it
+        owns — no device-0 round trip on multi-host).  Identity when
+        single-device: the tree is already placed by extraction."""
+        if self.mesh is None:
+            return params
+        from znicz_tpu.parallel.mesh import global_put
+
+        return {name: {k: global_put(
+            a, self._trainer.param_sharding(name, k, a))
+            for k, a in layer.items()}
+            for name, layer in params.items()}
+
     # -- the two halves of the ping-pong ---------------------------------------
 
     def stage(self, x: np.ndarray):
         """Host batch -> device buffer.  The put is dispatched
         asynchronously, so calling this while a previous ``infer_staged``
-        is still computing overlaps the H2D copy with that compute."""
+        is still computing overlaps the H2D copy with that compute.
+
+        An input already contiguous in the staging dtype is handed to
+        the put as-is (the frontend's assemble buffer always is); only
+        mismatched inputs pay a host copy (``stage_copies``).  On a
+        mesh the put places each device's ``rows/dp`` shard DIRECTLY
+        from the host buffer — one transfer per shard, no gather
+        through device 0 — so the batch is born in the layout the
+        sharded executable consumes."""
         import jax
 
-        return jax.device_put(np.ascontiguousarray(x, self.dtype))
+        if not (isinstance(x, np.ndarray) and x.dtype == self.dtype
+                and x.flags["C_CONTIGUOUS"]):
+            self._m["stage_copies"].inc()
+            x = np.ascontiguousarray(x, self.dtype)
+        if self.mesh is None:
+            return jax.device_put(x)
+        dp = self.data_parallel
+        if x.shape[0] % dp:
+            raise ValueError(
+                f"batch of {x.shape[0]} rows does not divide across "
+                f"the mesh's data axis (dp={dp}); pad to a ladder rung "
+                f"(rungs are snapped to multiples of dp)")
+        if self._tracer.enabled:
+            with self._tracer.span("model", "stage_sharded",
+                                   rows=int(x.shape[0]), shards=dp,
+                                   rows_per_shard=int(x.shape[0]) // dp):
+                return jax.device_put(x, self._data_sharding)
+        return jax.device_put(x, self._data_sharding)
 
     def _maybe_stall(self) -> None:
         """Chaos compute-fault hook (ISSUE 6): one ``decide_compute``
@@ -261,8 +404,6 @@ class ModelRunner:
         non-covering snapshot, or a warm failure raises and leaves the
         live generation untouched (``swap_failures`` counts it).
         Returns the snapshot's metadata."""
-        import jax
-
         from znicz_tpu import snapshotter
 
         if not self._swap_lock.acquire(blocking=False):
@@ -272,11 +413,16 @@ class ModelRunner:
             self.swapping = True
             try:
                 meta = snapshotter.load_inference(self.workflow, path)
-                params = self._trainer.extract_params()
+                # the NEW tree lands in the SAME placement the live one
+                # serves from (replicated/column-sharded on a mesh), so
+                # the flip below swaps like for like and the warmed
+                # rungs are jit cache hits on the sharded executables
+                params = self._place_params(
+                    self._trainer.extract_params())
                 for rung in (ladder or ()):
                     self._maybe_stall()
                     x = np.zeros((rung,) + self.sample_shape, self.dtype)
-                    np.asarray(self._fwd(params, jax.device_put(x)))
+                    np.asarray(self._fwd(params, self.stage(x)))
                 # retain the losing side for a disk-free rollback(); the
                 # hwm (not generation+1) allocates the new id, so a
                 # rolled-back-then-retried rollover never reuses a stamp
@@ -337,7 +483,10 @@ class ModelRunner:
                 "swaps": self.swaps,
                 "swap_failures": self.swap_failures,
                 "rollbacks": self.rollbacks,
+                "stage_copies": self.stage_copies,
                 "snapshot_path": self.snapshot_path,
                 "previous_retained": self._previous is not None,
                 "sample_shape": list(self.sample_shape),
-                "dtype": str(self.dtype)}
+                "dtype": str(self.dtype),
+                "mesh": self.mesh_shape,
+                "device_count": self.device_count}

@@ -454,6 +454,13 @@ class WebStatus:
                         state = ("DRAINING" if serving.get("draining")
                                  else "ready" if serving.get("ready")
                                  else "warming")
+                        mesh = m.get("mesh")
+                        mesh_text = ("single-device" if not mesh
+                                     else "x".join(
+                                         f"{k}={v}"
+                                         for k, v in mesh.items())
+                                     + f" ({m.get('device_count')} "
+                                       "devices)")
                         crows = "".join(
                             f"<tr><td>{html.escape(cid)}</td>"
                             f"<td>{c['accepted']}</td>"
@@ -467,7 +474,8 @@ class WebStatus:
                             f"<p>state: {state}, snapshot generation: "
                             f"{serving['generation']}"
                             f"{' (swapping)' if m.get('swapping') else ''}"
-                            f", swaps: {m.get('swaps')}</p>"
+                            f", swaps: {m.get('swaps')}, mesh: "
+                            f"{html.escape(mesh_text)}</p>"
                             f"<p>qps: {serving['qps']}, p50: "
                             f"{serving['p50_ms']} ms, p99: "
                             f"{serving['p99_ms']} ms, served: "
@@ -507,6 +515,10 @@ class WebStatus:
                             f"{'' if r['in_rotation'] else ' (warming)'}"
                             f"</td><td>{'ready' if r['ready'] else 'NOT'}"
                             f"</td><td>{r['gen']}</td>"
+                            # the mesh column (ISSUE 13): capacity-
+                            # weighted dispatch divides load by this
+                            f"<td>{html.escape('x'.join(str(v) for v in r['mesh'].values()) if r.get('mesh') else '1')}"
+                            f" ({r.get('device_count', 1)}d)</td>"
                             f"<td>{max(r['p99_ms_by_bucket'].values()) if r['p99_ms_by_bucket'] else '-'}"
                             f"</td><td>{r['in_flight']}</td>"
                             f"<td>{r['last_heartbeat_s']}s ago</td></tr>"
@@ -543,7 +555,8 @@ class WebStatus:
                             f"{bal['hedge_delay_ms']} ms</p>"
                             f"{roll_html}"
                             "<table border=1><tr><th>replica</th>"
-                            "<th>ready</th><th>gen</th><th>p99 ms</th>"
+                            "<th>ready</th><th>gen</th><th>mesh</th>"
+                            "<th>p99 ms</th>"
                             "<th>in-flight</th><th>heartbeat</th></tr>"
                             f"{frows}</table>")
                     cli = snap.get("serving_client")
