@@ -487,7 +487,7 @@ def test_finding_render_and_key():
     assert f.to_json()["severity"] == "error"
 
 
-# -- zmq-loop (ISSUE 12 satellite: the single-dataplane seam) ------------------
+# -- transport-core (ISSUE 14: the unified dataplane) --------------------------
 
 _ZMQ_FORKED = """
     import zmq
@@ -524,17 +524,85 @@ _ZMQ_RIDES_COMMON = """
         server.bind(("127.0.0.1", 0))             # not a ZMQ socket
 """
 
+_DISPATCH_FORKED = """
+    import zmq
 
-def test_zmq_loop_fixture_pair():
-    from znicz_tpu.analysis.zmq_loop import ZmqLoopChecker
+    def serve(self):
+        from znicz_tpu.network_common import bind_with_retry, make_poller
 
-    findings = _check(ZmqLoopChecker(), _ZMQ_FORKED)
-    rules = sorted(f.message.split(" ")[1] for f in findings)
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        bind_with_retry(sock, "tcp://127.0.0.1:5555")
+        poller = make_poller(sock)
+        while True:
+            if poller.poll(20):                  # hand-rolled dispatch
+                sock.recv_multipart()
+"""
+
+_RECONNECT_FORKED = """
+    import time
+    import zmq
+
+    def fetch(self, ctx):
+        for attempt in range(8):
+            sock = ctx.socket(zmq.REQ)           # fresh-socket retry
+            try:
+                sock.send(b"x")
+                return sock.recv()
+            except zmq.Again:
+                time.sleep(0.25 * (2 ** attempt))  # raw backoff too
+            finally:
+                sock.close(0)
+"""
+
+_CLIENT_RIDES_CORE = """
+    def fetch(self, endpoint):
+        from znicz_tpu.transport import Endpoint, RetryPolicy
+
+        ep = Endpoint(endpoint, retry=RetryPolicy.for_training_client())
+        for attempt in range(8):
+            try:
+                return ep.rpc_message({"cmd": "job"})
+            except Exception:
+                ep.backoff(attempt + 1)
+
+    def single_socket_wait(self):
+        # .poll on a bare SOCKET is a wait, not a dispatch loop
+        while self._sock.poll(20):
+            self._sock.recv()
+
+    def lifecycle(self, ctx):
+        import zmq
+        sock = ctx.socket(zmq.DEALER)            # created ONCE,
+        try:                                     # closed once: not a
+            sock.connect("tcp://127.0.0.1:1")    # reconnect cycle
+        finally:
+            sock.close(0)
+"""
+
+
+def test_transport_core_fixture_pairs():
+    from znicz_tpu.analysis.transport_core import TransportCoreChecker
+
+    findings = _check(TransportCoreChecker(), _ZMQ_FORKED)
     # two raw binds (name + self-attr receivers) and one raw Poller
     assert len(findings) == 3
     assert sum("Poller" in f.message for f in findings) == 1
     assert sum("bind_with_retry" in f.message for f in findings) == 2
-    assert not _check(ZmqLoopChecker(), _ZMQ_RIDES_COMMON)
-    # network_common itself is the sanctioned home
-    assert not _check(ZmqLoopChecker(), _ZMQ_FORKED,
+    assert not _check(TransportCoreChecker(), _ZMQ_RIDES_COMMON)
+    # network_common and the transport package itself are sanctioned
+    assert not _check(TransportCoreChecker(), _ZMQ_FORKED,
                       rel="network_common.py")
+    assert not _check(TransportCoreChecker(), _DISPATCH_FORKED,
+                      rel="transport/core.py")
+
+
+def test_transport_core_dispatch_and_reconnect():
+    from znicz_tpu.analysis.transport_core import TransportCoreChecker
+
+    dispatch = _check(TransportCoreChecker(), _DISPATCH_FORKED)
+    assert sum("dispatch loop" in f.message for f in dispatch) == 1
+    reconnect = _check(TransportCoreChecker(), _RECONNECT_FORKED)
+    assert sum("reconnect cycle" in f.message for f in reconnect) == 1
+    assert sum("backoff sleep" in f.message for f in reconnect) == 1
+    assert not _check(TransportCoreChecker(), _CLIENT_RIDES_CORE)

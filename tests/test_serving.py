@@ -171,13 +171,14 @@ def test_batcher_rate_limit_per_client():
     for _ in range(4):                    # the whole burst still there
         assert b.submit(_creq(2, "c")) is None
 
-    # the bucket table is bounded: idle (refilled) buckets are swept
-    # once MAX_BUCKETS distinct client ids have been seen
-    b._buckets.clear()
-    b.MAX_BUCKETS = 8
+    # the bucket table is bounded (the transport core's AdmissionTable
+    # since ISSUE 14): idle (refilled) buckets are swept once max_peers
+    # distinct client ids have been seen
+    b._table._buckets.clear()
+    b._table.max_peers = 8
     for i in range(40):
         b.submit(_creq(1, f"eph-{i}"))
-    assert len(b._buckets) <= 8
+    assert len(b._table) <= 8
 
 
 def test_batcher_drr_interleaves_clients_and_bounds_one():
@@ -260,13 +261,14 @@ def test_codec_frames_byte_identical_and_counted():
     assert codec.bytes_in == codec.bytes_out
     assert dinfo["message_bytes"] == codec.bytes_in
     assert codec.compression_ratio("in") == pytest.approx(1.0)
-    # refusal: counted, legacy-framed (single pickle any peer can read)
-    frames = codec.refusal("bad frame: torn")
+    # refusal: counted, legacy-framed (single pickle any peer can
+    # read), slug + wording from the transport core (ISSUE 14)
+    frames = codec.refusal("torn")
     assert codec.bad_frames == 1
     import pickle
 
     rep = pickle.loads(frames[0])
-    assert rep["bad_frame"] and "torn" in rep["error"]
+    assert rep["bad_frame"] and rep["error"] == "bad frame: torn"
 
 
 def test_server_counters_ride_the_codec(tmp_path):

@@ -1,0 +1,757 @@
+"""Unified async transport core (ISSUE 14): one fault model for every
+plane.
+
+Covers: the RetryPolicy/CircuitBreaker/Endpoint primitives (constants
+preserved per plane), the new robustness the unification bought —
+training-client fail-fast breaker, master per-slave ingress admission,
+training-job deadline propagation — the ``partition`` chaos kind, the
+byte-identity regression proof (wire frames, resume snapshot dicts,
+``/status.json`` counter names unchanged by the port), and the
+cross-plane chaos soak driving master + relay + frontend + balancer
+through the SAME FaultSchedule seed (lean here; full soak behind
+``slow``)."""
+
+import hashlib
+import pickle
+import threading
+import time
+from collections import Counter as _Counter
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+SEED = 14
+
+
+def _make_workflow(tmp_path, max_epochs=2, n_train=120):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = n_train
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = max_epochs
+    root.common.dirs.snapshots = str(tmp_path)
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def _handshake_fields(workflow):
+    from znicz_tpu.network_common import handshake_request
+
+    msg = handshake_request(workflow)
+    del msg["cmd"]
+    return msg
+
+
+class _EmptyWorkflow:
+    """The minimal object ``workflow_digest`` accepts — client-side
+    tests that never reach compute need no real graph."""
+
+    forwards = ()
+    gds = ()
+
+
+class _ScriptedMaster:
+    """A scripted REP peer: ``script(req) -> reply dict`` (or the
+    string ``"die"`` to close the socket and go silent — the client
+    sees pure timeouts from then on)."""
+
+    def __init__(self, script):
+        self.script = script
+        self.endpoint = None
+        self.requests = []
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+
+    def _loop(self):
+        import zmq
+
+        from znicz_tpu.parallel import wire
+
+        sock = zmq.Context.instance().socket(zmq.REP)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.bind("tcp://127.0.0.1:*")
+        self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+        self._ready.set()
+        try:
+            while True:
+                raw = sock.recv_multipart()
+                req, _ = wire.decode_message(raw)
+                self.requests.append(req)
+                rep = self.script(req)
+                if rep == "die":
+                    return
+                frames, _ = wire.encode_message(rep)
+                sock.send_multipart(frames)
+        finally:
+            sock.close(0)
+
+    def join(self, timeout=30):
+        self._thread.join(timeout)
+
+
+# -- RetryPolicy: one backoff curve, per-plane constants -----------------------
+
+
+def test_retry_policy_constants_preserved_per_plane():
+    from znicz_tpu.transport import RetryPolicy
+
+    train = RetryPolicy.for_training_client(jitter_key="s1/backoff")
+    # client.py's historical curve: 0.25 doubling to the 5s cap
+    assert [train.delay(n) for n in (1, 2, 3, 4, 5, 6, 99)] == \
+        [0.25, 0.5, 1.0, 2.0, 4.0, 5.0, 5.0]
+    assert train.spent(9) and not train.spent(8)
+    relay = RetryPolicy.for_relay_upstream()
+    # relay.py's historical curve: 0.05 doubling to 2.0, exponent <= 5
+    assert [relay.delay(n) for n in (1, 2, 5, 6, 7, 99)] == \
+        [0.05, 0.1, 0.8, 1.6, 1.6, 1.6]
+    brk = RetryPolicy.for_breaker(0.5, 30.0)
+    # serving/client.py's breaker backoff: un-jittered doubling
+    assert [brk.jittered(n) for n in (1, 2, 3)] == [0.5, 1.0, 2.0]
+    # jitter is deterministic per key (fleet de-sync, replayable)
+    a = RetryPolicy.for_training_client(jitter_key="k")
+    b = RetryPolicy.for_training_client(jitter_key="k")
+    seq_a = [a.jittered(n) for n in range(1, 6)]
+    assert seq_a == [b.jittered(n) for n in range(1, 6)]
+    assert all(0.5 * a.delay(n) <= seq_a[n - 1] <= 1.5 * a.delay(n)
+               for n in range(1, 6))
+
+
+def test_circuit_breaker_open_probe_close_cycle():
+    from znicz_tpu.transport import (CircuitBreaker, CircuitOpenError,
+                                     RetryPolicy)
+
+    events = []
+    brk = CircuitBreaker(window=4, threshold=2,
+                         backoff=RetryPolicy.for_breaker(0.05, 1.0),
+                         on_event=events.append, peer="unit")
+    brk.record("a", False)
+    brk.record("b", False)
+    assert brk.state == "open" and events == ["open"]
+    with pytest.raises(CircuitOpenError, match="circuit open"):
+        brk.admit()
+    assert events[-1] == "short_circuit"
+    time.sleep(0.07)                    # backoff expires -> half-open
+    brk.admit()
+    assert brk.state == "half_open"
+    assert brk.arm_probe("p1") and brk.probe == "p1"
+    with pytest.raises(CircuitOpenError, match="half-open"):
+        brk.admit()                     # one probe at a time
+    brk.record("p1", True)              # probe success closes + resets
+    assert brk.state == "closed" and brk.failure_counts() == (0, 0)
+    # a failed probe re-opens with the DOUBLED backoff
+    brk.record("a", False)
+    brk.record("b", False)
+    time.sleep(0.07)
+    brk.admit()
+    brk.arm_probe("p2")
+    brk.record("p2", False)
+    assert brk.state == "open"
+    assert brk.remaining() > 0.05       # second open: 2 x 0.05 window
+
+
+# -- Endpoint: the one client fault model --------------------------------------
+
+
+def test_endpoint_fault_model_and_resend_same_bytes():
+    from znicz_tpu.parallel import wire
+    from znicz_tpu.transport import BadReply, Endpoint, PeerTimeout
+
+    mode = {"v": "garbage"}
+
+    def script(req):
+        if mode["v"] == "garbage":
+            return {"_": GarbageOnTheWire()}
+        return {"ok": True, "echo": req.get("n")}
+
+    class GarbageOnTheWire:
+        def __reduce__(self):           # decodes on the wire to a raise
+            return (_raise, ())
+
+    master = _ScriptedMaster(script)
+    ep = Endpoint(master.endpoint, recv_timeout_s=0.4)
+    frames, _ = wire.encode_message({"cmd": "ping", "n": 7})
+    frames = [bytes(f) for f in frames]
+    with pytest.raises(BadReply):
+        ep.rpc(list(frames))
+    assert not ep.connected             # EFSM: fresh socket next call
+    mode["v"] = "sane"
+    # resend-same-bytes: the SAME frames, new socket, clean reply
+    assert ep.rpc(list(frames))["echo"] == 7
+    # silence -> PeerTimeout
+    mode["v"] = "die"
+
+    def die_script(req):
+        return "die"
+
+    master.script = die_script
+    with pytest.raises(PeerTimeout):
+        ep.rpc(list(frames))
+    ep.close()
+    master.join()
+
+
+def _raise():
+    raise ValueError("scripted wire garbage")
+
+
+# -- partition: the seeded drop-ALL window (ISSUE 14 satellite) ----------------
+
+
+def test_partition_windows_deterministic_and_independent():
+    from znicz_tpu.parallel.chaos import FaultSchedule
+
+    a = FaultSchedule(SEED, drop=0.1, corrupt=0.1,
+                      partition_s=(0.2, 0.4), partition_gap_s=(0.3, 0.6))
+    b = FaultSchedule(SEED, drop=0.1, corrupt=0.1,
+                      partition_s=(0.2, 0.4), partition_gap_s=(0.3, 0.6))
+    assert a.partition_windows("req", 5) == b.partition_windows("req", 5)
+    # per-direction streams differ; both are ordered and disjoint
+    assert a.partition_windows("req", 5) != a.partition_windows("rep", 5)
+    for direction in ("req", "rep"):
+        wins = a.partition_windows(direction, 6)
+        for (s0, e0), (s1, e1) in zip(wins, wins[1:]):
+            assert e0 < s1
+        for s, e in wins:
+            assert 0.2 <= e - s <= 0.4
+            assert a.in_partition(direction, (s + e) / 2)
+            assert not a.in_partition(direction, s - 0.01)
+            assert not a.in_partition(direction, e + 0.01)
+    # adding partitions leaves the wire stream byte-identical
+    plain = FaultSchedule(SEED, drop=0.1, corrupt=0.1)
+    assert a.decisions(300) == plain.decisions(300)
+    assert not plain.in_partition("req", 1.0)       # disabled
+    with pytest.raises(ValueError, match="partition"):
+        FaultSchedule(1, partition_s=(0.4, 0.2))
+    with pytest.raises(ValueError, match="gap"):
+        FaultSchedule(1, partition_s=(0.1, 0.2),
+                      partition_gap_s=(0.0, 0.1))
+
+
+def test_chaos_proxy_partition_drops_whole_window():
+    """A real network partition through the proxy: EVERY frame of the
+    partitioned direction is dropped for the window (counted
+    ``partition``, distinct from per-message ``drop``), and the
+    unified reconnect path rides it out — traffic flows again after
+    the window closes."""
+    from znicz_tpu.parallel import wire
+    from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
+    from znicz_tpu.transport import Endpoint, PeerTimeout
+
+    master = _ScriptedMaster(lambda req: {"ok": True})
+    # one deterministic req-direction window: gap 0.2s, duration 0.6s
+    sched = FaultSchedule(SEED, partition_s=(0.6, 0.6),
+                          partition_gap_s=(0.2, 0.2))
+    front = f"tcp://127.0.0.1:{_free_port()}"
+    proxy = ChaosProxy(front, master.endpoint, sched).start()
+    ep = Endpoint(front, recv_timeout_s=0.15)
+    frames, _ = wire.encode_message({"cmd": "ping"})
+    frames = [bytes(f) for f in frames]
+    outcomes = []
+    t0 = time.time()
+    try:
+        while time.time() - t0 < 1.6:
+            try:
+                ep.rpc(list(frames))
+                outcomes.append((time.time() - t0, True))
+            except PeerTimeout:
+                outcomes.append((time.time() - t0, False))
+        counters = proxy.counters
+        assert counters["req"]["partition"] > 0
+        # windows (lo == hi makes them exact): [0.2, 0.8) and
+        # [1.0, 1.6) — inside a window NOTHING got through; in the
+        # pre-window and inter-window gaps traffic flowed again
+        assert any(ok for t, ok in outcomes if t < 0.2)
+        assert not any(ok for t, ok in outcomes if 0.25 < t < 0.75)
+        assert any(ok for t, ok in outcomes if 0.82 < t < 0.98)
+        assert not any(ok for t, ok in outcomes if 1.05 < t < 1.55)
+        assert any(a == "partition" for _, d, a in proxy.log
+                   if d == "req")
+    finally:
+        ep.close()
+        proxy.stop()
+        master.script = lambda req: "die"
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- TransportLoop: dispatch + built-in faults ---------------------------------
+
+
+def test_transport_loop_rep_dispatch_ticks_and_builtin_faults():
+    from znicz_tpu.parallel import wire
+    from znicz_tpu.parallel.chaos import FaultSchedule
+    from znicz_tpu.transport import (Endpoint, TransportLoop,
+                                     bad_frame_reply)
+
+    loop = TransportLoop("unit_test_plane")
+    ticks = []
+
+    def reply_fn(frames):
+        try:
+            req, _ = wire.decode_message(frames)
+        except wire.WireError as exc:
+            out, _ = wire.encode_message(bad_frame_reply(exc))
+            return out
+        out, _ = wire.encode_message({"ok": True, "n": req.get("n")})
+        return out
+
+    sock = loop.bind_rep("tcp://127.0.0.1:*")
+    endpoint = loop.resolved_endpoint(sock)
+    loop.register(sock, reply_fn, reply=True)
+    loop.add_tick(lambda: ticks.append(1))
+    # drop=1.0 would starve a REP peer forever — the hook REMAPS drop
+    # to corrupt on lockstep sockets, so the refusal path answers
+    loop.inject_faults(FaultSchedule(3, drop=0.49, corrupt=0.5))
+    t = threading.Thread(target=loop.run, kwargs={"poll_ms": 5},
+                         daemon=True)
+    t.start()
+    ep = Endpoint(endpoint, recv_timeout_s=2.0)
+    try:
+        for n in range(6):
+            rep = ep.rpc_message({"cmd": "ping", "n": n})
+            # every message was corrupted -> every reply is the SHARED
+            # refusal slug (wording from transport.bad_frame_reply)
+            assert rep["bad_frame"] is True
+            assert rep["error"].startswith("bad frame: ")
+        counts = loop.fault_counts()
+        assert counts["corrupt"] == loop.messages == 6
+        assert counts["drop"] == 0      # remapped, counted as done
+        assert ticks                    # idle ticks ran
+    finally:
+        loop.stop()
+        t.join(10)
+        loop.close()
+        ep.close()
+
+
+# -- the new robustness the unification bought (acceptance criteria) -----------
+
+
+def test_training_client_fail_fast_breaker():
+    """A dead master opens the training client's breaker: later
+    attempts are refused LOCALLY (no socket, no recv-timeout burn) and
+    the prefetcher shares the same verdict — while the give-up budget
+    still counts real probe failures, so run() returns bounded."""
+    from znicz_tpu.client import Client
+
+    master = _ScriptedMaster(
+        lambda req: {"ok": True, "version": 3, "class_lengths": [1, 1]}
+        if req.get("cmd") == "register" else "die")
+    client = Client(_EmptyWorkflow(), endpoint=master.endpoint,
+                    slave_id="brk")
+    root.common.engine.slave_breaker_failures = 2
+    t0 = time.perf_counter()
+    try:
+        done = client.run(poll_sleep=0.01, recv_timeout=0.25,
+                          max_reconnects=4, backoff_base=0.02,
+                          backoff_cap=0.1, connect_retries=3)
+    finally:
+        root.common.engine.slave_breaker_failures = 4
+    elapsed = time.perf_counter() - t0
+    assert done == 0
+    # the breaker opened on the dead master and RE-opened on every
+    # failed probe; the give-up stayed bounded by the probe budget
+    assert client._m["breaker_opens"].value >= 2
+    assert client.breaker is not None and client.breaker.state == "open"
+    # fail-fast is live right now: an attempt inside the open window
+    # is refused locally — no socket, no recv-timeout burn (this is
+    # what the prefetcher and any other call site shares)
+    from znicz_tpu.transport import CircuitOpenError
+
+    with pytest.raises(CircuitOpenError, match="circuit open"):
+        client.breaker.admit()
+    assert client._m["breaker_short_circuits"].value >= 1
+    # bounded: 5 real probes x (0.25s timeout + <=0.15 jittered
+    # backoff) — nowhere near the un-breakered worst case
+    assert elapsed < 8.0
+    master.join()
+
+
+def test_master_per_slave_ingress_admission(tmp_path):
+    """The serving plane's TokenBucket on the master's door: a job-
+    request flood is answered ``wait`` (counted, policy-slugged), the
+    slave keeps its membership AND its finished work is still taken —
+    refused-as-wait, never fatal."""
+    from znicz_tpu.server import Server
+
+    wf = _make_workflow(tmp_path)
+    root.common.engine.ingress_rate_limit = 3.0
+    try:
+        srv = Server(wf, endpoint="tcp://127.0.0.1:0")
+    finally:
+        root.common.engine.ingress_rate_limit = 0.0
+    assert srv._handle({"cmd": "register", "id": "s1",
+                        **_handshake_fields(wf)})["ok"]
+    replies = [srv._handle({"cmd": "job", "id": "s1"})
+               for _ in range(12)]
+    jobs = [r for r in replies if "job" in r or "jobs" in r]
+    limited = [r for r in replies if r.get("rate_limited")]
+    assert jobs and limited
+    assert all(r.get("wait") and r.get("policy") == "rate_limited"
+               for r in limited)
+    assert srv.rate_limited_ingress == len(limited)
+    # never fatal: still a registered member, and its UPDATE (finished
+    # work) is admitted even while the job bucket is empty
+    assert "s1" in srv.registered
+    job = jobs[0]
+    rep = srv._handle({"cmd": "update", "id": "s1",
+                       "job_id": job["job_id"], "deltas": None,
+                       "metrics": {"loss": 1.0, "n_err": 1}})
+    assert rep["ok"] is True
+    # the bucket refills: a paced slave passes admission again (the
+    # reply may still be the epoch-tail ``wait`` — what matters is
+    # that the RATE LIMIT no longer refuses it)
+    time.sleep(0.5)
+    n_limited = srv.rate_limited_ingress
+    assert not srv._handle({"cmd": "job", "id": "s1"}).get(
+        "rate_limited")
+    assert srv.rate_limited_ingress == n_limited
+
+
+def test_training_job_deadline_stamped_and_dropped(tmp_path):
+    """Deadline propagation on the training plane (PR 6's 'expired
+    work never computed', fleet-wide): the master stamps a budget on
+    every job; a client drops an expired job UNCOMPUTED; a relay drops
+    expired queued jobs UNSERVED and re-stamps the remaining budget."""
+    from znicz_tpu.client import Client
+    from znicz_tpu.parallel.relay import Relay
+    from znicz_tpu.server import Server
+
+    # (a) the master stamps deadline_ms = the live reap timeout
+    wf = _make_workflow(tmp_path)
+    srv = Server(wf, endpoint="tcp://127.0.0.1:0", job_timeout=7.5)
+    assert srv._handle({"cmd": "register", "id": "s1",
+                        **_handshake_fields(wf)})["ok"]
+    job = srv._handle({"cmd": "job", "id": "s1"})
+    assert job["deadline_ms"] == pytest.approx(7500.0)
+
+    # (b) the client drops an expired job uncomputed and moves on
+    def script(req):
+        if req.get("cmd") == "register":
+            return {"ok": True, "version": 3, "class_lengths": [1, 1]}
+        if req.get("cmd") == "job":
+            if script.served:
+                return {"done": True}
+            script.served = True
+            return {"job_id": 1, "job": {"class": 0, "size": 1},
+                    "params": {}, "train": False, "deadline_ms": 0.0}
+        return {"ok": True}
+
+    script.served = False
+    master = _ScriptedMaster(script)
+    client = Client(_EmptyWorkflow(), endpoint=master.endpoint,
+                    slave_id="ddl")
+    root.common.engine.job_prefetch = False
+    try:
+        done = client.run(poll_sleep=0.01, recv_timeout=2.0)
+    finally:
+        root.common.engine.job_prefetch = True
+    assert done == 0
+    assert client._m["jobs_expired"].value == 1
+    master.join()
+
+    # (c) the relay drops expired QUEUED jobs and re-stamps budgets
+    relay = Relay(upstream="tcp://127.0.0.1:1", bind="tcp://127.0.0.1:*")
+    now = time.monotonic()
+    relay._children["c1"] = time.time()
+    relay._jobq = [
+        ({"job_id": 1, "job": {}, "_deadline_t": now - 1.0,
+          "deadline_ms": 5000.0}, {"p": 1}),
+        ({"job_id": 2, "job": {}, "_deadline_t": now + 5.0,
+          "deadline_ms": 5000.0}, {"p": 1}),
+    ]
+    rep = relay._child_job({"cmd": "job", "count": 1, "id": "c1"}, "c1")
+    assert rep["job_id"] == 2           # the expired job never served
+    assert 0 < rep["deadline_ms"] <= 5000.0     # remaining budget
+    assert relay.jobs_expired == 1
+    assert relay.stats()["jobs_expired"] == 1
+
+
+# -- byte-identity regression proof (guards PR 4/PR 5 compatibility) -----------
+
+#: sha256 over the canonical update + job-reply frame stacks below —
+#: the PORT (and anything after it) must not move a single wire byte.
+#: Recompute ONLY for a deliberate, documented protocol revision.
+_UPDATE_DIGEST = "5f691c603048a7201231598e62c7874d" \
+                 "c974dfe8a46dde50d83d28a024aeaad7"
+_JOB_DIGEST = "c02a608e0edd31679e03353735c5fc00" \
+              "b26b48d8dcaf7ef8cd184cf3b62e6246"
+
+
+def _canonical_update():
+    rng = np.random.default_rng(7)
+    return {"cmd": "update", "id": "s1", "job_id": 42,
+            "step": 3, "trace_id": "t-42",
+            "deltas": {"fc1": {"weights":
+                               rng.standard_normal((8, 4))
+                               .astype(np.float32),
+                               "bias": rng.standard_normal(4)
+                               .astype(np.float32)}},
+            "metrics": {"loss": 0.5, "n_err": 3}}
+
+
+def _canonical_job():
+    rng = np.random.default_rng(8)
+    return {"job_id": 42, "trace_id": "t-42", "train": True, "step": 3,
+            "job": {"indices": np.arange(16, dtype=np.int64),
+                    "class": 2, "size": 16, "last_minibatch": False,
+                    "class_ended": False, "epoch_number": 0},
+            "params": {"fc1": {"weights": rng.standard_normal((8, 4))
+                               .astype(np.float32)}}}
+
+
+def _frames_digest(frames):
+    h = hashlib.sha256()
+    for f in frames:
+        b = bytes(f)
+        h.update(len(b).to_bytes(8, "little"))
+        h.update(b)
+    return h.hexdigest()
+
+
+def test_wire_frames_byte_identical_after_the_port():
+    from znicz_tpu.parallel import wire
+
+    up, _ = wire.encode_message(_canonical_update())
+    job, _ = wire.encode_message(_canonical_job())
+    assert _frames_digest(up) == _UPDATE_DIGEST
+    assert _frames_digest(job) == _JOB_DIGEST
+    # the Codec rides the same encoder: byte-identical frames
+    codec = wire.Codec(owner="byte_identity")
+    assert [bytes(f) for f in codec.encode(_canonical_update())] \
+        == [bytes(f) for f in up]
+
+
+#: the resume-snapshot contract (PR 2/PR 9/PR 11): these keys MUST
+#: keep existing so pre-port snapshots restore and post-port snapshots
+#: stay readable by the historical tooling
+_RESUME_MASTER_KEYS = {
+    "loader_pos", "hold", "outstanding", "job_seq", "jobs_by_slave",
+    "lr_iteration", "apply_step", "decision_acc", "durations",
+    "delta_norms", "counters"}
+_RESUME_COUNTER_KEYS = {
+    "jobs_done", "jobs_requeued", "stale_updates", "bad_updates",
+    "bad_frames", "quarantined_updates", "reregistrations", "bytes_in",
+    "bytes_out", "updates_received", "update_bytes_in", "prefetch_hit",
+    "aggregated_updates", "stale_refused", "weighted_applies",
+    "replans", "preemptions_ridden", "rate_limited_ingress",
+    "tensor_bytes_raw_in", "tensor_bytes_wire_in",
+    "tensor_bytes_raw_out", "tensor_bytes_wire_out"}
+
+
+def test_resume_snapshot_and_status_names_unchanged(tmp_path):
+    import json
+    import urllib.request
+
+    from znicz_tpu.server import Server
+    from znicz_tpu.web_status import WebStatus
+
+    wf = _make_workflow(tmp_path)
+    srv = Server(wf, endpoint="tcp://127.0.0.1:0",
+                 resume_path=str(tmp_path / "resume.pkl"))
+    assert srv._handle({"cmd": "register", "id": "s1",
+                        **_handshake_fields(wf)})["ok"]
+    srv._handle({"cmd": "job", "id": "s1"})
+    srv.save_resume(str(tmp_path / "resume.pkl"))
+    with open(tmp_path / "resume.pkl", "rb") as f:
+        snap = pickle.load(f)
+    assert set(snap["master"].keys()) == _RESUME_MASTER_KEYS
+    assert set(snap["master"]["counters"].keys()) == _RESUME_COUNTER_KEYS
+    # a PRE-PORT snapshot (no post-port counter keys) still restores
+    snap["master"]["counters"].pop("rate_limited_ingress")
+    with open(tmp_path / "old.pkl", "wb") as f:
+        pickle.dump(snap, f)
+    srv2 = Server(wf, endpoint="tcp://127.0.0.1:0")
+    srv2.restore_resume(str(tmp_path / "old.pkl"))
+    assert srv2.resumed and srv2.rate_limited_ingress == 0
+    # /status.json: every historical master counter name still there
+    status = WebStatus(port=0).start()
+    try:
+        status.register(wf)
+        status.register_server(srv)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            master = json.load(r)["master"]
+    finally:
+        status.stop()
+    for name in ("jobs_done", "jobs_requeued", "stale_updates",
+                 "bytes_in", "bytes_out", "updates_received",
+                 "update_bytes_in", "bytes_per_update",
+                 "compression_ratio", "prefetch_hit", "bad_updates",
+                 "bad_frames", "quarantined_updates",
+                 "reregistrations", "resume_saves", "job_timeout_s",
+                 "aggregated_updates", "rate_limited_ingress"):
+        assert name in master, name
+    for name in ("min_slaves", "members", "degraded", "apply_step",
+                 "staleness_bound", "stale_refused", "replans",
+                 "preemptions_ridden"):
+        assert name in master["elastic"], name
+
+
+# -- the cross-plane chaos soak ------------------------------------------------
+
+
+def _expected_rep_faults(schedule, n):
+    """What a REP plane's built-in hook must have counted after ``n``
+    messages: the schedule's transport stream replayed, with ``drop``
+    remapped to ``corrupt`` (lockstep sockets cannot drop)."""
+    c = _Counter(schedule.decide_transport(i)[0] for i in range(n))
+    return {"drop": 0, "corrupt": c["drop"] + c["corrupt"]}
+
+
+def _expected_router_faults(schedule, n):
+    c = _Counter(schedule.decide_transport(i)[0] for i in range(n))
+    return {"drop": c["drop"], "corrupt": c["corrupt"]}
+
+
+def _assert_plane_accounted(loop, schedule, rep: bool):
+    """The soak's core claim: this plane's fault counters are EXACTLY
+    the shared schedule's transport stream replayed over its message
+    count — same seed, same core, every plane."""
+    expect = (_expected_rep_faults if rep else
+              _expected_router_faults)(schedule, loop.messages)
+    assert loop.fault_counts() == expect
+
+
+def _soak_training(tmp_path, schedule, n_slaves=1, max_epochs=2,
+                   n_train=120):
+    """master + relay + slaves, built-in chaos on BOTH REP planes."""
+    from znicz_tpu.client import Client
+    from znicz_tpu.parallel.relay import Relay
+    from znicz_tpu.server import Server
+
+    master_wf = _make_workflow(tmp_path / "m", max_epochs=max_epochs,
+                               n_train=n_train)
+    master_ep = f"tcp://127.0.0.1:{_free_port()}"
+    srv = Server(master_wf, endpoint=master_ep, job_timeout=30.0)
+    srv.transport_chaos = schedule
+    srv_thread = threading.Thread(target=srv.serve,
+                                  kwargs={"linger": 1.0}, daemon=True)
+    srv_thread.start()
+    relay = Relay(upstream=master_ep,
+                  bind=f"tcp://127.0.0.1:{_free_port()}",
+                  flush_s=0.05)
+    relay.transport_chaos = schedule
+    relay.start()
+    slaves = [Client(_make_workflow(tmp_path / f"s{i}",
+                                    max_epochs=max_epochs,
+                                    n_train=n_train),
+                     endpoint=relay.bind, slave_id=f"soak-s{i}")
+              for i in range(n_slaves)]
+    threads = [threading.Thread(
+        target=s.run, kwargs=dict(poll_sleep=0.01, recv_timeout=3.0,
+                                  max_reconnects=30), daemon=True)
+        for s in slaves]
+    for t in threads:
+        t.start()
+    srv_thread.join(120)
+    assert not srv_thread.is_alive(), "master never finished under chaos"
+    for t in threads:
+        t.join(30)
+    relay.stop()
+    assert bool(srv.decision.complete)
+    assert srv.jobs_done > 0
+    _assert_plane_accounted(srv._transport, schedule, rep=True)
+    _assert_plane_accounted(relay._transport, schedule, rep=True)
+    # corrupted ingress surfaced through the planes' OWN refusal paths
+    faults = srv._transport.fault_counts()["corrupt"] \
+        + relay._transport.fault_counts()["corrupt"]
+    refusals = srv.bad_frames + relay.bad_frames
+    assert refusals == faults
+    return srv, relay
+
+
+def _soak_balancer(schedule, n_requests=16):
+    """balancer + scripted replicas + client, built-in chaos on the
+    balancer's ROUTER plane."""
+    from znicz_tpu.parallel.chaos import ScriptedReplica
+    from znicz_tpu.serving import InferenceClient, ReplicaBalancer
+
+    bal = ReplicaBalancer(heartbeat_s=0.05, replica_ttl_s=1.0,
+                          failover_timeout_s=0.5, hedge=False)
+    bal.transport_chaos = schedule
+    bal.start()
+    reps = [ScriptedReplica(bal.endpoint, f"soak-r{i}",
+                            boot_scale=2.0).start() for i in range(2)]
+    cli = InferenceClient(bal.endpoint, timeout=30.0,
+                          resend_after_s=0.4)
+    try:
+        deadline = time.time() + 10
+        while bal.ready_count() < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert bal.ready_count() == 2
+        x = np.arange(4, dtype=np.float32)
+        for _ in range(n_requests):
+            y = np.asarray(cli.infer(x, timeout=30.0))
+            assert np.array_equal(y.ravel(), x * 2.0)
+        _assert_plane_accounted(bal._transport, schedule, rep=False)
+        assert bal.ledger()["balanced"]
+    finally:
+        cli.close()
+        bal.stop()
+        for r in reps:
+            r.kill()
+    return bal
+
+
+def test_cross_plane_chaos_soak_lean(tmp_path):
+    """ONE FaultSchedule seed drives every plane's built-in fault hook
+    — master, relay, serving frontend, balancer — and each plane's
+    fault counters are exactly that schedule's transport stream
+    replayed through the shared core, while every plane survives and
+    completes its work (ISSUE 14 acceptance)."""
+    from znicz_tpu.parallel.chaos import FaultSchedule
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    schedule = FaultSchedule(SEED, drop=0.04, corrupt=0.04)
+    # training plane: master + relay (REP lockstep, drop->corrupt)
+    _soak_training(tmp_path, schedule)
+    # serving frontend (ROUTER): same seed, its own stream replay
+    wf = _make_workflow(tmp_path / "serve")
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=2.0)
+    srv.transport_chaos = schedule
+    srv.start()
+    cli = InferenceClient(srv.endpoint, timeout=30.0,
+                          resend_after_s=0.4)
+    try:
+        x = np.zeros((2, 784), np.float32)
+        y0 = cli.infer(x, timeout=30.0)
+        for _ in range(10):
+            assert np.array_equal(cli.infer(x, timeout=30.0), y0)
+        _assert_plane_accounted(srv._transport, schedule, rep=False)
+    finally:
+        cli.close()
+        srv.stop()
+    # balancer plane (ROUTER): same seed again
+    _soak_balancer(schedule)
+
+
+@pytest.mark.slow
+def test_cross_plane_chaos_soak_full(tmp_path):
+    """The full soak: doubled fault rates, two slaves through the
+    relay over a longer run, and heavier balancer traffic — all from
+    ONE seed (the partition ride-through has its own dedicated proxy
+    test above)."""
+    from znicz_tpu.parallel.chaos import FaultSchedule
+
+    schedule = FaultSchedule(SEED + 1, drop=0.08, corrupt=0.08)
+    srv, relay = _soak_training(tmp_path, schedule, n_slaves=2,
+                                max_epochs=3, n_train=300)
+    assert srv.jobs_done >= 10
+    _soak_balancer(schedule, n_requests=48)

@@ -12,10 +12,11 @@ Five rules over one shared AST walk of ``znicz_tpu/``:
     declared DEFAULTS tables;
   - ``counter-registry``   — no new ad-hoc ``self.<counter> += 1``
     outside the telemetry registry;
-  - ``zmq-loop``           — no new raw ``zmq.Poller()``/socket
-    ``.bind()`` forked outside ``network_common`` (ride
-    ``make_poller``/``bind_with_retry`` — the single-dataplane seam,
-    ROADMAP item 4).
+  - ``transport-core``     — no new dataplane machinery outside
+    ``znicz_tpu/transport``: raw ``zmq.Poller()``/socket ``.bind()``,
+    hand-rolled poller dispatch loops, fresh-socket reconnect cycles
+    and raw exponential-backoff sleeps are all flagged (the grown
+    ``zmq-loop`` rule; ROADMAP item 4, landed in ISSUE 14).
 
 Run ``python -m znicz_tpu.analysis`` (add ``--json`` for dashboards).
 Suppress one site with ``# znicz: ignore[rule]``; accept a triaged

@@ -208,11 +208,11 @@ def default_checkers(pkg_dir: pathlib.Path) -> List[Checker]:
     from .counters import CounterRegistryChecker
     from .jit_purity import JitPurityChecker
     from .threads import ThreadSharedStateChecker
-    from .zmq_loop import ZmqLoopChecker
+    from .transport_core import TransportCoreChecker
 
     return [ThreadSharedStateChecker(), JitPurityChecker(),
             ConfigKnobChecker(pkg_dir), CounterRegistryChecker(),
-            ZmqLoopChecker()]
+            TransportCoreChecker()]
 
 
 def run(pkg_dir: pathlib.Path,

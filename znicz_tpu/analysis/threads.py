@@ -173,6 +173,7 @@ class _MethodScan(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
+        spawnish = False
         if isinstance(func, ast.Attribute):
             recv = _self_attr(func.value)
             if recv is not None and func.attr in MUTATORS:
@@ -182,6 +183,7 @@ class _MethodScan(ast.NodeVisitor):
                 self.calls.add(method)
             # thread / timer / executor handing out self.<m>
             if func.attr in ("Thread", "Timer"):
+                spawnish = True
                 for kw in node.keywords:
                     if kw.arg == "target":
                         tgt = _self_attr(kw.value)
@@ -192,16 +194,33 @@ class _MethodScan(ast.NodeVisitor):
                     if tgt is not None:
                         self.spawn_targets.add(tgt)
             elif func.attr == "submit":
+                spawnish = True
                 if node.args:
                     tgt = _self_attr(node.args[0])
                     if tgt is not None:
                         self.spawn_targets.add(tgt)
         elif isinstance(func, ast.Name) and func.id in ("Thread", "Timer"):
+            spawnish = True
             for kw in node.keywords:
                 if kw.arg == "target":
                     tgt = _self_attr(kw.value)
                     if tgt is not None:
                         self.spawn_targets.add(tgt)
+        if not spawnish:
+            # a bound method handed BY REFERENCE to an ordinary call —
+            # loop.register(sock, self._handle), add_tick(self._flush)
+            # (the ISSUE 14 TransportLoop handler pattern) — runs on
+            # the CALLER's thread when the loop dispatches it: treat
+            # the reference as a call edge, or every handler registered
+            # this way would drop out of the worker-reachable set and
+            # its whole dispatch tree would misclassify as "other
+            # threads" (Thread/Timer/submit references stay SPAWN
+            # targets — new-thread entries, not same-thread edges)
+            for val in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                tgt = _self_attr(val)
+                if tgt is not None:
+                    self.calls.add(tgt)
         self.generic_visit(node)
 
     # nested defs/lambdas inside a method run on the same thread as the

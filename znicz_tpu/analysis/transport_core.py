@@ -1,0 +1,213 @@
+"""transport-core: no NEW dataplane machinery outside znicz_tpu/transport.
+
+The ``zmq-loop`` rule (PR 11) kept new planes on
+``network_common.bind_with_retry``/``make_poller``; ISSUE 14 finished
+ROADMAP item 4 — ONE event-loop transport core
+(:mod:`znicz_tpu.transport`) that the master, relays, serving frontend,
+replica balancer, chaos drivers and both clients all ride.  This rule
+is the grown version: every way a plane used to re-fork the dataplane
+is now flagged, with ZERO baseline entries (the codebase was converted,
+not baselined).
+
+Flagged (outside ``network_common.py`` and ``transport/``):
+
+  - ``zmq.Poller()`` instantiation — ride
+    ``transport.TransportLoop`` (or, at the lowest level,
+    ``network_common.make_poller``);
+  - ``.bind(...)`` on a ZMQ socket — a receiver assigned from a
+    ``*.socket(...)`` call in the same function scope — use
+    ``bind_with_retry`` / the TransportLoop bind factories;
+  - ``.poll(...)`` on a POLLER (a receiver assigned from
+    ``make_poller(...)`` or ``zmq.Poller()`` in the same scope) — a
+    hand-rolled dispatch loop; ride ``TransportLoop.run`` with
+    handlers and ticks;
+  - ``time.sleep(...)`` of an expression containing a ``**`` power —
+    a raw exponential backoff; use ``transport.RetryPolicy`` (one
+    curve, constants per plane, deterministic jitter);
+  - a socket created (``*.socket(...)``) AND ``.close()``d inside ONE
+    loop body — the hand-rolled fresh-socket reconnect cycle; ride
+    ``transport.Endpoint`` (reconnect + backoff + resend-same-bytes +
+    breaker in one home).
+
+Deliberately silent: ``.connect(...)`` (no restart race), ``.poll()``
+on a bare SOCKET (a single-socket wait — graphics, the serving
+client's pump — is not a dispatch loop), ``.bind`` on non-socket
+receivers, and create/close in straight-line lifecycle code (creation
+outside a loop never matches the reconnect signature).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Checker, Finding, Module
+
+RULE = "transport-core"
+
+#: the sanctioned homes for raw dataplane machinery
+EXEMPT_FILES = ("network_common.py",)
+EXEMPT_DIRS = ("transport/",)
+
+
+def _exempt(rel: str) -> bool:
+    return rel in EXEMPT_FILES or any(rel.startswith(d)
+                                      for d in EXEMPT_DIRS)
+
+
+def _receiver_key(node: ast.expr) -> str | None:
+    """A trackable receiver: a bare name or a ``self.<attr>`` chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _scope_nodes(body: Iterable[ast.stmt]):
+    """Every node of one scope, PRUNING nested function bodies — they
+    are their own scopes and are scanned separately (``ast.walk`` has
+    no pruning, so a naive walk double-counts)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue                # a nested scope: scanned separately
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _assigns_from(body: Iterable[ast.stmt], match) -> set:
+    """Receiver keys assigned from a call ``match(call)`` approves,
+    anywhere in this scope (order-insensitive)."""
+    out = set()
+    for node in _scope_nodes(body):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and match(node.value)):
+            for target in node.targets:
+                key = _receiver_key(target)
+                if key is not None:
+                    out.add(key)
+    return out
+
+
+def _is_socket_call(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) \
+        and call.func.attr == "socket"
+
+
+def _is_poller_call(call: ast.Call) -> bool:
+    """``zmq.Poller()`` or ``make_poller(...)`` (bare or attribute)."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "Poller" and isinstance(func.value, ast.Name) \
+                and func.value.id == "zmq":
+            return True
+        return func.attr == "make_poller"
+    return isinstance(func, ast.Name) and func.id == "make_poller"
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    func = call.func
+    return (isinstance(func, ast.Attribute) and func.attr == "sleep"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time")
+
+
+def _has_power(node: ast.expr) -> bool:
+    return any(isinstance(n, ast.BinOp) and isinstance(n.op, ast.Pow)
+               for n in ast.walk(node))
+
+
+class TransportCoreChecker(Checker):
+    name = RULE
+
+    def check(self, module: Module):
+        if _exempt(module.rel):
+            return []
+        findings: List[Finding] = []
+        # Poller instantiation + raw backoff sleeps: anywhere
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr == "Poller"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "zmq"):
+                findings.append(Finding(
+                    RULE, module.rel, node.lineno,
+                    "raw zmq.Poller() forked outside the transport "
+                    "core — ride transport.TransportLoop (ROADMAP "
+                    "item 4, landed in ISSUE 14)"))
+            elif _is_time_sleep(node) and node.args \
+                    and _has_power(node.args[0]):
+                findings.append(Finding(
+                    RULE, module.rel, node.lineno,
+                    "raw exponential backoff sleep outside the "
+                    "transport core — use transport.RetryPolicy (one "
+                    "backoff curve, per-plane constants, deterministic "
+                    "jitter)"))
+        # per-scope checks
+        scopes: List[Iterable[ast.stmt]] = [module.tree.body]
+        scopes += [n.body for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for body in scopes:
+            sockets = _assigns_from(body, _is_socket_call)
+            pollers = _assigns_from(body, _is_poller_call)
+            for node in _scope_nodes(body):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                recv = _receiver_key(node.func.value)
+                if node.func.attr == "bind" and recv in sockets:
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        "raw ZMQ socket .bind() outside the transport "
+                        "core — use network_common.bind_with_retry / "
+                        "TransportLoop's bind factories: a restarted "
+                        "peer races its predecessor's port release "
+                        "(EADDRINUSE), and the retry policy has ONE "
+                        "home"))
+                elif node.func.attr == "poll" and recv in pollers:
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        "hand-rolled poller dispatch loop outside the "
+                        "transport core — ride transport."
+                        "TransportLoop.run(handlers, ticks): chaos "
+                        "hooks, telemetry and dispatch conventions "
+                        "come free there (ISSUE 14)"))
+            # reconnect cycle: socket created AND closed inside ONE
+            # loop body — the fresh-socket retry idiom.  Deduped by
+            # close-site line: nested loops both contain the same
+            # close() node, and one violation is one finding.
+            seen_closes: set = set()
+            for node in _scope_nodes(body):
+                if not isinstance(node, (ast.While, ast.For)):
+                    continue
+                loop_sockets = _assigns_from(node.body, _is_socket_call)
+                if not loop_sockets:
+                    continue
+                for sub in _scope_nodes(node.body):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "close"
+                            and _receiver_key(sub.func.value)
+                            in loop_sockets
+                            and sub.lineno not in seen_closes):
+                        seen_closes.add(sub.lineno)
+                        findings.append(Finding(
+                            RULE, module.rel, sub.lineno,
+                            "hand-rolled reconnect cycle (socket "
+                            "created and closed inside one retry "
+                            "loop) outside the transport core — ride "
+                            "transport.Endpoint: fresh-socket "
+                            "reconnect, capped-exp backoff, resend-"
+                            "same-bytes and the breaker live in ONE "
+                            "home (ISSUE 14)"))
+                        break
+        return findings

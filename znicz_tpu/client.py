@@ -38,12 +38,13 @@ from znicz_tpu.loader.base import TRAIN
 # losing counts (regression-tested in tests/test_telemetry.py)
 from znicz_tpu.telemetry.metrics import registered_property as \
     _client_counter
-
-
-class _BadReply(Exception):
-    """A reply frame stack that did not decode to a dict (truncated or
-    corrupt) — handled exactly like a timeout: fresh socket, backoff,
-    re-register."""
+# the ONE client fault model (ISSUE 14): fresh-socket reconnect,
+# capped-exp backoff with jitter, resend-same-bytes, breaker fail-fast
+# and deadline budgets all live in znicz_tpu/transport/ now
+from znicz_tpu.transport import (BadReply as _BadReply,  # noqa: F401
+                                 CircuitBreaker, CircuitOpenError,
+                                 Endpoint, PeerTimeout, RetryPolicy,
+                                 local_deadline)
 
 
 def scheduled_hypers_rows(base_hypers: Dict, mbs: List[dict]) -> Dict:
@@ -74,9 +75,13 @@ class _JobPrefetcher:
     At most one fetch is ever outstanding; ``request()`` arms it,
     ``take()`` collects the decoded reply (or None on a miss).  A
     transport fault on THIS socket never touches the main loop's
-    reconnect state machine: the prefetcher closes its (EFSM-broken)
-    socket, counts ``prefetch_reconnects``/``prefetch_bad_replies`` on
-    the client, and the main socket simply fetches the job itself.
+    reconnect state machine: the prefetcher's OWN
+    :class:`~znicz_tpu.transport.Endpoint` resets its (EFSM-broken)
+    socket, ``prefetch_reconnects``/``prefetch_bad_replies`` are
+    counted on the client, and the main socket simply fetches the job
+    itself.  The prefetcher SHARES the client's circuit breaker (ISSUE
+    14): once a dead master opens it, prefetch attempts fail fast
+    locally instead of burning a full recv timeout per compute round.
 
     Semantics note: job N+1 is issued while update N is still local, so
     its params snapshot misses this slave's own last delta — delay-1
@@ -85,9 +90,10 @@ class _JobPrefetcher:
     tests/test_wire.py covers).  A strictly sequential single-slave
     trajectory needs ``root.common.engine.job_prefetch = False``."""
 
-    def __init__(self, client: "Client", connect, recv_timeout: float):
+    def __init__(self, client: "Client", make_endpoint,
+                 recv_timeout: float):
         self._client = client
-        self._connect = connect         # () -> fresh connected REQ socket
+        self._ep: Endpoint = make_endpoint()    # own socket, SHARED breaker
         self._recv_timeout = float(recv_timeout)
         self._want = threading.Event()
         self._ready = threading.Event()
@@ -136,11 +142,8 @@ class _JobPrefetcher:
         self._thread.join(self._recv_timeout + 5.0)
 
     def _loop(self) -> None:
-        import zmq
-
         from znicz_tpu.parallel import wire
 
-        sock = None
         try:
             # _stop is re-checked at the TOP of every lap: stop() can
             # land while a fetch is in flight, and that fetch's finally
@@ -153,20 +156,24 @@ class _JobPrefetcher:
                     break
                 rep = None
                 try:
-                    if sock is None:
-                        sock = self._connect()
                     frames, _ = wire.encode_message(
                         {"cmd": "job", "prefetch": True,
                          "id": self._client.slave_id})
-                    rep = self._client._rpc_frames(sock, frames)
-                except zmq.Again:
-                    # starved receive: same EFSM rule as the main loop —
-                    # the socket can never be reused; reconnect fresh on
-                    # the next fetch
+                    rep = self._ep.rpc(frames)
+                    # receipt stamp for the deadline check (ISSUE 14):
+                    # a prefetched job can sit in the slot for a whole
+                    # compute round — its budget burns from HERE, not
+                    # from when take() collects it
+                    rep["_received_at"] = time.monotonic()
+                except CircuitOpenError:
+                    # master known-dead (shared breaker): fail fast
+                    # with no socket, no recv-timeout burn; the main
+                    # loop's breaker accounting covers it
+                    pass
+                except PeerTimeout:
+                    # starved receive: the Endpoint already dropped the
+                    # EFSM-broken socket; reconnect fresh on next fetch
                     self._client._m["prefetch_reconnects"].inc()
-                    if sock is not None:
-                        sock.close(0)
-                        sock = None
                 except _BadReply:
                     # undecodable reply: count it (the chaos accounting
                     # holds bad-reply counters to the corrupt-frame
@@ -174,9 +181,6 @@ class _JobPrefetcher:
                     # mirror the main loop's fresh-socket policy
                     self._client._m["prefetch_bad_replies"].inc()
                     self._client._m["prefetch_reconnects"].inc()
-                    if sock is not None:
-                        sock.close(0)
-                        sock = None
                 except Exception:
                     # connect/send fault or a genuine bug: never a
                     # "bad reply" — log it (a silently-spinning
@@ -187,16 +191,13 @@ class _JobPrefetcher:
                         "%s: prefetch fetch failed", self._client.slave_id,
                         exc_info=True)
                     self._client._m["prefetch_reconnects"].inc()
-                    if sock is not None:
-                        sock.close(0)
-                        sock = None
+                    self._ep.reset()
                 finally:
                     self._slot = rep
                     self._want.clear()
                     self._ready.set()
         finally:
-            if sock is not None:        # closed by the owning thread
-                sock.close(0)
+            self._ep.close()            # closed by the owning thread
 
 
 class Client:
@@ -209,6 +210,11 @@ class Client:
         "prefetch_hits": "jobs consumed from the prefetcher",
         "prefetch_reconnects": "fresh-socket retries (prefetcher)",
         "prefetch_bad_replies": "undecodable replies (prefetcher)",
+        # the unified fault model (ISSUE 14)
+        "jobs_expired": "jobs dropped uncomputed: deadline budget spent",
+        "breaker_opens": "circuit breaker transitions to open",
+        "breaker_short_circuits": "attempts refused locally: breaker "
+                                  "open (no socket, no recv timeout)",
     }
 
     # (historical attribute properties generated from COUNTERS after
@@ -238,6 +244,15 @@ class Client:
         #: pending update or finishing the in-flight job — exactly what
         #: a killed instance loses
         self._preempt = threading.Event()
+        #: the shared circuit breaker (ISSUE 14), built per run() from
+        #: ``slave_breaker_failures`` and shared with the prefetcher —
+        #: tests read its state after run() returns
+        self._breaker: Optional[CircuitBreaker] = None
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        """The run's shared transport breaker (None before run())."""
+        return self._breaker
 
     def preempt(self) -> None:
         """Kill switch for the preemption chaos harness: the slave
@@ -245,27 +260,14 @@ class Client:
         reaper recovers its in-flight job."""
         self._preempt.set()
 
-    def _rpc(self, sock, msg: dict) -> dict:
+    def _rpc(self, ep: Endpoint, msg: dict) -> dict:
+        """One exchange through the shared transport Endpoint (ISSUE
+        14); already-encoded resends go straight to ``ep.rpc``."""
         from znicz_tpu.parallel import wire
 
         msg["id"] = self.slave_id
         frames, _ = wire.encode_message(msg)
-        return self._rpc_frames(sock, frames)
-
-    def _rpc_frames(self, sock, frames: List) -> dict:
-        """One REQ/REP exchange of already-encoded v3 frames (the resend
-        path re-sends these exact bytes — no re-serialization)."""
-        from znicz_tpu.parallel import wire
-
-        sock.send_multipart(frames, copy=False)
-        raw = sock.recv_multipart()     # zmq.Again propagates
-        try:
-            rep, _ = wire.decode_message(raw)
-            if not isinstance(rep, dict):
-                raise TypeError(f"reply decodes to {type(rep).__name__}")
-        except Exception as exc:
-            raise _BadReply(str(exc)) from None
-        return rep
+        return ep.rpc(frames)
 
     def _apply_params(self, params: Dict) -> None:
         for f in self.workflow.forwards:
@@ -326,21 +328,6 @@ class Client:
                 gd.run()
         return metrics
 
-    def _connect(self, ctx, timeout_ms: int):
-        import zmq
-
-        sock = ctx.socket(zmq.REQ)
-        # duplicate tolerance: RELAXED lets a fresh request follow a
-        # failed cycle; CORRELATE stamps request ids so a duplicated or
-        # stale reply (chaos proxy, restarted master) is DISCARDED
-        # instead of being returned for the NEXT request
-        sock.setsockopt(zmq.REQ_RELAXED, 1)
-        sock.setsockopt(zmq.REQ_CORRELATE, 1)
-        sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
-        sock.setsockopt(zmq.LINGER, 0)
-        sock.connect(self.endpoint)
-        return sock
-
     def engine_name(self) -> str:
         return "unit"
 
@@ -371,11 +358,20 @@ class Client:
         (root.common.engine.job_prefetch, default on), and the pending
         update is kept as its encoded frames so a resend after a
         reconnect ships the same bytes.  Deltas go out quantized per
-        root.common.engine.wire_dtype with error-feedback residuals."""
-        import logging
-        import random
+        root.common.engine.wire_dtype with error-feedback residuals.
 
-        import zmq
+        Unified fault model (ISSUE 14): the socket/backoff machinery is
+        the shared :class:`~znicz_tpu.transport.Endpoint` (constants
+        unchanged), PLUS the serving plane's circuit breaker
+        (``root.common.engine.slave_breaker_failures`` consecutive
+        transport failures open it; attempts then fail fast locally —
+        no fresh socket, no recv-timeout burn — until its backoff
+        admits a probe; 0 disables), and jobs whose ``deadline_ms``
+        budget (stamped by the master at dispatch) is spent before
+        compute are DROPPED uncomputed (``jobs_expired``) — the
+        master's reaper re-queues them, so expired work is never
+        computed, fleet-wide."""
+        import logging
 
         from znicz_tpu.core.config import root
         from znicz_tpu.network_common import handshake_request
@@ -390,6 +386,8 @@ class Client:
         if backoff_cap is None:
             backoff_cap = float(
                 root.common.engine.get("slave_backoff_cap", 5.0))
+        breaker_failures = int(
+            root.common.engine.get("slave_breaker_failures", 4))
         # wire-v3 knobs: delta quantization (error-feedback residuals
         # live in the encoder, one per tensor) and the job prefetcher.
         # Literal config chains at each read site — the engine-knob lint
@@ -404,10 +402,39 @@ class Client:
         # the scheduled hypers inside each TRAIN minibatch — applied in
         # _run_one / scheduled_hypers_rows for both engines.)
 
-        rng = random.Random(f"{self.slave_id}/backoff")
-        ctx = zmq.Context.instance()
-        timeout_ms = int(recv_timeout * 1000)
-        sock = self._connect(ctx, timeout_ms)
+        # ONE breaker for both sockets: a dead master is detected once,
+        # then the main loop AND the prefetcher fail fast together
+        _brk_counters = {"open": self._m["breaker_opens"],
+                         "short_circuit": self._m["breaker_short_circuits"]}
+
+        def _brk_event(name: str) -> None:
+            counter = _brk_counters.get(name)
+            if counter is not None:
+                counter.inc()
+
+        self._breaker = CircuitBreaker(
+            window=max(2 * breaker_failures, 1),
+            threshold=breaker_failures, on_event=_brk_event,
+            # probe windows pace on the SLAVE's own backoff constants
+            # (un-jittered), not the serving plane's — per-plane
+            # constants, one curve (ISSUE 14); CONSECUTIVE semantics:
+            # the historical reconnect counter reset on every success,
+            # so a sustained-but-survivable fault rate (chaos soaks
+            # live there) keeps training and only a DEAD master opens
+            # the breaker
+            backoff=RetryPolicy(backoff_base, backoff_cap,
+                                jitter=False),
+            peer=self.endpoint, consecutive=True)
+
+        def make_endpoint() -> Endpoint:
+            return Endpoint(
+                self.endpoint, recv_timeout_s=recv_timeout,
+                retry=RetryPolicy.for_training_client(
+                    backoff_base, backoff_cap, max_reconnects,
+                    jitter_key=f"{self.slave_id}/backoff"),
+                breaker=self._breaker)
+
+        ep = make_endpoint()
         registered = False
         ever_registered = False
         failures = 0                    # CONSECUTIVE transport failures
@@ -438,8 +465,9 @@ class Client:
             return True
 
         def reconnect(exc) -> bool:
-            """Fresh socket + backoff; False when the budget is spent."""
-            nonlocal sock, registered, failures
+            """Fresh socket + backoff (the Endpoint already dropped the
+            EFSM-broken socket); False when the budget is spent."""
+            nonlocal prefetcher, registered, failures
             if isinstance(exc, _BadReply):
                 self._m["bad_replies"].inc()
             failures += 1
@@ -463,7 +491,16 @@ class Client:
                         self.slave_id, self.endpoint, failures - 1,
                         fallback)
                     self.endpoint = fallback
+                    ep.endpoint = fallback
                     self._fallback_endpoint = None
+                    if prefetcher is not None:
+                        # its Endpoint still dials the DEAD relay (and
+                        # would keep filing timeouts into the shared
+                        # breaker): retire it; re-created lazily on
+                        # the next real job at the new endpoint —
+                        # exactly the rehome path's discipline
+                        prefetcher.stop()
+                        prefetcher = None
                     failures = 1
                 else:
                     log.warning(
@@ -471,14 +508,18 @@ class Client:
                         "(master gone for good?)", self.slave_id,
                         failures - 1)
                     return False
-            sock.close(0)               # EFSM: unusable after a timeout
             self._m["reconnects"].inc()
             registered = False
-            delay = min(backoff_cap,
-                        backoff_base * (2 ** min(failures - 1, 16)))
-            time.sleep(delay * (0.5 + rng.random()))
-            sock = self._connect(ctx, timeout_ms)
+            ep.backoff(failures)        # capped exp + jitter, one home
             return True
+
+        def short_circuit() -> None:
+            """The breaker refused the attempt locally (ISSUE 14): no
+            socket was built, no recv timeout burned.  Pace on the
+            breaker's own probe window WITHOUT spending the reconnect
+            budget — the budget counts REAL probe failures, so a dead
+            master still yields a bounded, fail-fast give-up."""
+            ep.breaker_wait(cap_s=backoff_cap)
 
         try:
             while True:
@@ -486,9 +527,12 @@ class Client:
                     break               # simulated spot kill (ISSUE 11)
                 if not registered:
                     try:
-                        rep = self._rpc(sock,
+                        rep = self._rpc(ep,
                                         handshake_request(self.workflow))
-                    except (zmq.Again, _BadReply) as exc:
+                    except CircuitOpenError:
+                        short_circuit()
+                        continue
+                    except (PeerTimeout, _BadReply) as exc:
                         if not reconnect(exc):
                             break
                         continue
@@ -518,8 +562,8 @@ class Client:
                         self._fallback_endpoint = self.endpoint
                         self.endpoint = rehome
                         registered = False
-                        sock.close(0)
-                        sock = self._connect(ctx, timeout_ms)
+                        ep.reset()
+                        ep.endpoint = rehome
                         if prefetcher is not None:
                             # its socket still points at the OLD peer —
                             # retire it; re-created lazily on the next
@@ -529,8 +573,11 @@ class Client:
                     continue
                 if update_frames is not None:
                     try:
-                        rep = self._rpc_frames(sock, update_frames)
-                    except (zmq.Again, _BadReply) as exc:
+                        rep = ep.rpc(update_frames)
+                    except CircuitOpenError:
+                        short_circuit()
+                        continue
+                    except (PeerTimeout, _BadReply) as exc:
                         if not reconnect(exc):
                             break
                         continue        # re-register, then RE-SEND it
@@ -565,8 +612,12 @@ class Client:
                             self._m["prefetch_hits"].inc()
                 if rep is None:
                     try:
-                        rep = self._rpc(sock, {"cmd": "job"})
-                    except (zmq.Again, _BadReply) as exc:
+                        rep = self._rpc(ep, {"cmd": "job"})
+                        rep["_received_at"] = time.monotonic()
+                    except CircuitOpenError:
+                        short_circuit()
+                        continue
+                    except (PeerTimeout, _BadReply) as exc:
                         if not reconnect(exc):
                             break
                         continue
@@ -588,11 +639,26 @@ class Client:
                 if prefetch_on and prefetcher is None:
                     # started lazily on the FIRST real job, so a run the
                     # master refuses (or never serves) spawns no thread
-                    prefetcher = _JobPrefetcher(
-                        self, lambda: self._connect(ctx, timeout_ms),
-                        recv_timeout)
+                    prefetcher = _JobPrefetcher(self, make_endpoint,
+                                                recv_timeout)
                 if prefetcher is not None:
                     prefetcher.request()   # fetch job N+1 during compute
+                # deadline propagation (ISSUE 14): the master stamps a
+                # ``deadline_ms`` BUDGET on every job (its reap
+                # timeout); a job that sat in the prefetch slot or a
+                # relay queue past it is already re-queued master-side,
+                # so computing it is pure waste — drop it UNCOMPUTED
+                # and fetch fresh work (PR 6's "expired work never
+                # computed", now on the training plane)
+                deadline = local_deadline(rep.get("deadline_ms"),
+                                          now=rep.get("_received_at"))
+                if deadline is not None and time.monotonic() > deadline:
+                    self._m["jobs_expired"].inc()
+                    log.info("%s: job %s expired before compute "
+                             "(budget %.0fms) — dropped, master "
+                             "re-queues it", self.slave_id,
+                             rep.get("job_id"), rep.get("deadline_ms"))
+                    continue
                 self._apply_params(params)
                 before = {name: {k: np.asarray(v) for k, v in layer.items()}
                           for name, layer in params.items()}
@@ -616,7 +682,7 @@ class Client:
         finally:
             if prefetcher is not None:
                 prefetcher.stop()
-            sock.close(0)
+            ep.close()
         return self.jobs_done
 
 

@@ -223,6 +223,15 @@ ENGINE_DEFAULTS = {
     "slave_reconnects": 8,
     "slave_backoff_base": 0.25,
     "slave_backoff_cap": 5.0,
+    # unified transport core (ISSUE 14)
+    "slave_breaker_failures": 4,  # consecutive transport failures that
+    #                               open the training client's breaker
+    #                               (fail-fast to a dead master); 0 off
+    "ingress_rate_limit": 0.0,    # per-slave JOB requests/s the master
+    #                               admits (flood -> wait); 0 = off
+    "ingress_rate_burst": 0.0,    # bucket capacity; 0 = auto (1s rate)
+    "job_deadline": True,         # stamp deadline_ms budgets on jobs;
+    #                               expired jobs drop at slave/relay
     "quarantine_norm_mult": 25.0,
     "master_snapshot_s": 10.0,
     "wire_dtype": "float32",      # "float32" | "bfloat16" | "int8"

@@ -121,11 +121,12 @@ def bind_with_retry(sock, endpoint: str, attempts: int = 40,
 
 def make_poller(*sockets):
     """One home for the poll-loop registration convention (the first
-    concrete step toward ROADMAP item 4's single dataplane): every ZMQ
-    serve loop — master REP, relay, serving frontend, chaos proxy,
-    replica balancer — registers its sockets POLLIN through here, and
-    znicz-lint's ``zmq-loop`` rule flags any NEW raw ``zmq.Poller()``/
-    ``.bind()`` forked outside this module."""
+    concrete step toward ROADMAP item 4's single dataplane, now landed
+    as ``znicz_tpu/transport`` — ISSUE 14): every ZMQ serve loop rides
+    ``transport.TransportLoop``, which registers its sockets POLLIN
+    through here, and znicz-lint's ``transport-core`` rule flags any
+    NEW raw ``zmq.Poller()``/``.bind()``/poller dispatch loop forked
+    outside the transport package."""
     import zmq
 
     poller = zmq.Poller()

@@ -44,8 +44,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-#: schedule actions, in cumulative-probability order
-ACTIONS = ("drop", "corrupt", "dup", "delay", "forward")
+#: schedule actions, in cumulative-probability order (``partition`` is
+#: the window-based drop-ALL kind, ISSUE 14 — not in the per-message
+#: probability cascade)
+ACTIONS = ("drop", "corrupt", "dup", "delay", "forward", "partition")
 
 
 class FaultSchedule:
@@ -70,11 +72,23 @@ class FaultSchedule:
     #: leaves its wire and compute decisions byte-identical
     PREEMPT_SALT = 0x5B07
 
+    #: salt for the network-partition window stream (ISSUE 14) — same
+    #: independence contract: adding partitions to a schedule leaves
+    #: wire/compute/preempt decisions byte-identical
+    PARTITION_SALT = 0x9A27
+
+    #: salt for the transport core's built-in ingress hook
+    #: (TransportLoop.inject_faults) — its drop/corrupt stream must not
+    #: correlate with a ChaosProxy sharing the same seed
+    TRANSPORT_SALT = 0x7C04E
+
     def __init__(self, seed: int, drop: float = 0.0, corrupt: float = 0.0,
                  duplicate: float = 0.0, delay: float = 0.0,
                  delay_s: Tuple[float, float] = (0.05, 0.2),
                  stall: float = 0.0,
-                 stall_s: Tuple[float, float] = (0.02, 0.1)):
+                 stall_s: Tuple[float, float] = (0.02, 0.1),
+                 partition_s: Tuple[float, float] = (0.0, 0.0),
+                 partition_gap_s: Tuple[float, float] = (0.5, 2.0)):
         total = drop + corrupt + duplicate + delay
         if not 0.0 <= total < 1.0:
             raise ValueError(f"fault probabilities sum to {total}; "
@@ -93,6 +107,32 @@ class FaultSchedule:
         #: (counted) deadline refusal
         self.stall = float(stall)
         self.stall_s = (float(stall_s[0]), float(stall_s[1]))
+        #: network partitions (ISSUE 14): a SEEDED drop-ALL window per
+        #: direction, distinct from per-message ``drop`` — during a
+        #: window EVERY frame of that direction is discarded, which is
+        #: what a real partition looks like to a reconnect state
+        #: machine (N consecutive timeouts, not N independent coin
+        #: flips).  ``partition_s`` is the window-duration range
+        #: ((0, 0) disables); ``partition_gap_s`` the connected-gap
+        #: range between windows.  Keep durations under the give-up
+        #: budgets (reconnect budget x backoff) or the soak proves
+        #: give-up instead of ride-through.
+        self.partition_s = (float(partition_s[0]), float(partition_s[1]))
+        self.partition_gap_s = (float(partition_gap_s[0]),
+                                float(partition_gap_s[1]))
+        if self.partition_s[0] < 0 or \
+                self.partition_s[1] < self.partition_s[0]:
+            raise ValueError(f"bad partition_s range {partition_s}")
+        if self.partition_s[1] > 0 and self.partition_gap_s[0] <= 0:
+            raise ValueError("partition_gap_s lower bound must be > 0 "
+                             "(back-to-back windows are one window)")
+        #: derived-window cache per direction (windows are pure in
+        #: (seed, k, direction) but deriving one costs an RNG build —
+        #: a proxy asking in_partition() per MESSAGE must not re-walk
+        #: the whole timetable each time).  Lock-guarded: one schedule
+        #: may drive several proxies/loops on different threads.
+        self._pwin: Dict[str, List[Tuple[float, float]]] = {}
+        self._pwin_lock = threading.Lock()
 
     def decide(self, frame_no: int) -> Tuple[str, float]:
         """(action, delay_seconds) for the frame_no-th frame."""
@@ -131,6 +171,78 @@ class FaultSchedule:
             return "stall", lo + float(rng.random()) * (hi - lo)
         return "run", 0.0
 
+    def decide_transport(self, message_no: int) -> Tuple[str, float]:
+        """(action, 0.0) for the message_no-th inbound message of a
+        :class:`~znicz_tpu.transport.TransportLoop` built-in fault hook
+        (ISSUE 14): ``drop``/``corrupt``/``forward`` per this
+        schedule's drop/corrupt probabilities, on an independently
+        salted stream — a ChaosProxy sharing the seed keeps its own
+        decisions byte-identical.  (``dup``/``delay`` need a proxy in
+        the path; the in-loop hook maps their probability mass to
+        ``forward``.)"""
+        rng = np.random.default_rng(
+            (self.seed, int(message_no), self.TRANSPORT_SALT))
+        u = float(rng.random())
+        if u < self.drop:
+            return "drop", 0.0
+        if u < self.drop + self.corrupt:
+            return "corrupt", 0.0
+        return "forward", 0.0
+
+    #: directions a partition window stream exists for (the proxy's
+    #: two relay directions)
+    PARTITION_DIRECTIONS = ("req", "rep")
+
+    def _derive_window(self, direction: str, k: int,
+                       pos: float) -> Tuple[float, float]:
+        """Window ``k`` for ``direction`` given the previous window's
+        end ``pos`` — the pure derivation both accessors share."""
+        d = self.PARTITION_DIRECTIONS.index(direction)
+        rng = np.random.default_rng(
+            (self.seed, int(k), self.PARTITION_SALT, d))
+        gap = self.partition_gap_s[0] + float(rng.random()) * (
+            self.partition_gap_s[1] - self.partition_gap_s[0])
+        dur = self.partition_s[0] + float(rng.random()) * (
+            self.partition_s[1] - self.partition_s[0])
+        start = pos + gap
+        return start, start + dur
+
+    def _windows_through(self, direction: str, t: float,
+                         n: int = 0) -> List[Tuple[float, float]]:
+        """The cached window list, extended until it covers relative
+        time ``t`` (and holds at least ``n`` windows)."""
+        with self._pwin_lock:
+            wins = self._pwin.setdefault(direction, [])
+            while len(wins) < n or not wins or wins[-1][1] <= t:
+                start, end = self._derive_window(
+                    direction, len(wins),
+                    wins[-1][1] if wins else 0.0)
+                wins.append((start, end))
+            return list(wins)
+
+    def partition_windows(self, direction: str,
+                          n: int) -> List[Tuple[float, float]]:
+        """The first ``n`` partition windows for ``direction``, as
+        (start, end) seconds relative to the observer's epoch (the
+        proxy's loop start) — a pure function of (seed, direction), so
+        a soak's partition timetable replays identically run to run.
+        Empty when partitions are disabled."""
+        if self.partition_s[1] <= 0:
+            return []
+        return self._windows_through(direction, -1.0, n=int(n))[:int(n)]
+
+    def in_partition(self, direction: str, t: float) -> bool:
+        """True while ``direction`` is inside a partition window at
+        relative time ``t`` (drop ALL its frames).  O(log windows) per
+        call off the cache — the proxy asks once per MESSAGE."""
+        if self.partition_s[1] <= 0 or t < 0:
+            return False
+        import bisect
+
+        wins = self._windows_through(direction, t)
+        i = bisect.bisect_right(wins, (t, float("inf"))) - 1
+        return i >= 0 and wins[i][0] <= t < wins[i][1]
+
     def decide_preempt(self, target_no: int,
                        kill_s: Tuple[float, float] = (0.5, 2.0),
                        down_s: Tuple[float, float] = (1.0, 3.0)
@@ -148,18 +260,11 @@ class FaultSchedule:
         return float(kill_at), float(down)
 
 
-def corrupt_payload(payload: bytes) -> bytes:
-    """Deterministic frame corruption: truncate to a third and flip the
-    first byte — reliably undecodable (a torn pickle, or a tensor frame
-    whose length no longer matches its v3 manifest entry).  An empty
-    frame (a zero-length tensor buffer) grows a poison byte instead —
-    still a guaranteed manifest-length mismatch."""
-    if not payload:
-        return b"\xff"
-    cut = max(1, len(payload) // 3)
-    head = bytearray(payload[:cut])
-    head[0] ^= 0xFF
-    return bytes(head)
+# deterministic frame corruption: moved to the transport core (ISSUE
+# 14) so the proxy and TransportLoop's built-in ingress hook share one
+# mutation; re-exported here under the historical name
+from znicz_tpu.transport.core import (corrupt_message,      # noqa: E402
+                                      corrupt_payload)      # noqa: F401
 
 
 class ChaosProxy:
@@ -192,6 +297,7 @@ class ChaosProxy:
             for d in ("req", "rep") for a in ACTIONS}
         self.log: List[Tuple[int, str, str]] = []
         self._frame_no = 0
+        self._t0: Optional[float] = None    # partition-window epoch
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -232,78 +338,80 @@ class ChaosProxy:
             self._thread.join(timeout=10)
             self._thread = None
 
-    # -- the relay loop --------------------------------------------------------
+    # -- the relay loop (rides the transport core, ISSUE 14) -------------------
 
     def _corrupt_one(self, frames: List[bytes], frame_no: int
                      ) -> List[bytes]:
-        """Multipart-aware corruption (v3 framing): mutate exactly ONE
-        payload frame — metadata or any tensor buffer, picked as a pure
-        function of (seed, frame_no) — and never the routing envelope
-        (peer identity / REQ correlate id / empty delimiter), so the
-        refusal reply can still be routed back."""
-        from znicz_tpu.parallel.wire import split_envelope
+        """Multipart-aware corruption (v3 framing): exactly ONE payload
+        frame, picked as a pure function of (seed, frame_no), never the
+        routing envelope — the shared transport-core mutation."""
+        return corrupt_message(frames,
+                               (self.schedule.seed, int(frame_no), 0xC0))
 
-        envelope, payload = split_envelope(frames)
-        if not payload:                 # degenerate: nothing to corrupt
-            return frames
-        pick = int(np.random.default_rng(
-            (self.schedule.seed, int(frame_no), 0xC0))
-            .integers(len(payload)))
-        payload[pick] = corrupt_payload(payload[pick])
-        return envelope + payload
+    def _relay(self, frames: List[bytes], direction: str, out,
+               held: list, seq: List[int]) -> None:
+        """One message, one schedule decision (the fault-injection
+        dispatch both directions share).  A partition window for this
+        direction supersedes the per-message cascade: EVERY frame is
+        dropped and counted ``partition`` (its per-message stream index
+        is still consumed, so ``decide(i)`` purity is untouched)."""
+        fno = self._frame_no
+        self._frame_no += 1
+        if self.schedule.in_partition(direction,
+                                      time.time() - self._t0):
+            self._fault_counters[(direction, "partition")].inc()
+            self.log.append((fno, direction, "partition"))
+            return
+        action, delay = self.schedule.decide(fno)
+        self._fault_counters[(direction, action)].inc()
+        self.log.append((fno, direction, action))
+        if action == "drop":
+            return
+        if action == "corrupt":
+            out.send_multipart(self._corrupt_one(frames, fno))
+        elif action == "dup":
+            out.send_multipart(frames)
+            out.send_multipart(frames)
+        elif action == "delay":
+            seq[0] += 1
+            heapq.heappush(held,
+                           (time.time() + delay, seq[0], out, frames))
+        else:
+            out.send_multipart(frames)
 
     def _loop(self) -> None:
-        import zmq
+        from znicz_tpu.transport import TransportLoop
 
-        from znicz_tpu.network_common import bind_with_retry, make_poller
-
-        ctx = zmq.Context.instance()
-        front = ctx.socket(zmq.ROUTER)  # slaves' REQ sockets connect here
-        back = ctx.socket(zmq.DEALER)   # relays to the master's REP
-        front.setsockopt(zmq.LINGER, 0)
-        back.setsockopt(zmq.LINGER, 0)
-        bind_with_retry(front, self.front_endpoint)
-        back.connect(self.back_endpoint)
-        self._ready.set()
-        poller = make_poller(front, back)
+        loop = TransportLoop("chaos_proxy", stop=self._stop,
+                             instance=self.front_endpoint)
         held: list = []                 # (release_t, seq, out_sock, frames)
-        seq = 0
+        seq = [0]
         try:
-            while not self._stop.is_set():
+            front = loop.bind_router(self.front_endpoint)
+            back = loop.connect_dealer(self.back_endpoint)
+            loop.register(front, lambda frames: self._relay(
+                frames, "req", back, held, seq), drain=True)
+            loop.register(back, lambda frames: self._relay(
+                frames, "rep", front, held, seq), drain=True)
+
+            def release_due():
                 now = time.time()
                 while held and held[0][0] <= now:
                     _, _, out, frames = heapq.heappop(held)
                     out.send_multipart(frames)
-                timeout_ms = 20
-                if held:
-                    timeout_ms = max(1, min(
-                        timeout_ms, int((held[0][0] - now) * 1000)))
-                for sock, _ in poller.poll(timeout_ms):
-                    frames = sock.recv_multipart()
-                    direction = "req" if sock is front else "rep"
-                    out = back if sock is front else front
-                    fno = self._frame_no
-                    action, delay = self.schedule.decide(fno)
-                    self._fault_counters[(direction, action)].inc()
-                    self.log.append((fno, direction, action))
-                    self._frame_no += 1
-                    if action == "drop":
-                        continue
-                    if action == "corrupt":
-                        frames = self._corrupt_one(frames, fno)
-                        out.send_multipart(frames)
-                    elif action == "dup":
-                        out.send_multipart(frames)
-                        out.send_multipart(frames)
-                    elif action == "delay":
-                        seq += 1
-                        heapq.heappush(
-                            held, (time.time() + delay, seq, out, frames))
-                    else:
-                        out.send_multipart(frames)
+
+            def next_timeout_ms() -> int:
+                if not held:
+                    return 20
+                return max(1, min(20, int((held[0][0] - time.time())
+                                          * 1000)))
+
+            loop.add_tick(release_due)
+            self._t0 = time.time()
+            self._ready.set()
+            loop.run(timeout_fn=next_timeout_ms)
         finally:
-            front.close(0)
-            back.close(0)
+            loop.close()
 
 
 # -- resource-fault drivers (ISSUE 6) ------------------------------------------
@@ -657,61 +765,50 @@ class ScriptedReplica:
                     y=(x * np.float32(scale)).astype(np.float32))
 
     def _loop(self) -> None:
-        import zmq
-
-        from znicz_tpu.network_common import bind_with_retry, make_poller
         from znicz_tpu.parallel import wire
+        from znicz_tpu.transport import TransportLoop, bad_frame_reply
 
-        ctx = zmq.Context.instance()
-        sock = ctx.socket(zmq.ROUTER)
-        sock.setsockopt(zmq.LINGER, 0)
-        bind_with_retry(sock, self.bind)
-        with self._lock:
-            self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
-        hb = ctx.socket(zmq.DEALER)
-        hb.setsockopt(zmq.LINGER, 0)
-        hb.connect(self.announce)
-        poller = make_poller(sock, hb)
-        next_hb = 0.0
-        self._ready.set()
+        loop = TransportLoop("scripted_replica", stop=self._stop,
+                             instance=self.replica_id)
+        state = {"next_hb": 0.0}
         try:
-            while not self._stop.is_set():
+            sock = loop.bind_router(self.bind)
+            with self._lock:
+                self.endpoint = loop.resolved_endpoint(sock)
+            hb = loop.connect_dealer(self.announce)
+
+            def on_data(raw: List[bytes]) -> None:
+                envelope, payload = wire.split_envelope(raw)
+                try:
+                    req, _ = wire.decode_message(payload or raw)
+                except wire.WireError as exc:
+                    bad, _ = wire.encode_message(dict(
+                        bad_frame_reply(exc),
+                        replica_id=self.replica_id, error=str(exc)))
+                    sock.send_multipart(list(envelope) + bad)
+                    return
+                rep = self._answer(req)
+                if rep is None:
+                    return                  # blackholed
+                out, _ = wire.encode_message(rep)
+                sock.send_multipart(list(envelope) + out, copy=False)
+
+            def beat() -> None:
                 now = time.time()
-                if now >= next_hb:
-                    next_hb = now + self.heartbeat_s
+                if now >= state["next_hb"]:
+                    state["next_hb"] = now + self.heartbeat_s
                     frames, _ = wire.encode_message(self._heartbeat())
                     hb.send_multipart([b""] + frames)
-                if not poller.poll(5):
-                    continue
-                while True:                 # drain heartbeat acks
-                    try:
-                        hb.recv_multipart(zmq.NOBLOCK)
-                    except zmq.Again:
-                        break
-                while True:
-                    try:
-                        raw = sock.recv_multipart(zmq.NOBLOCK)
-                    except zmq.Again:
-                        break
-                    envelope, payload = wire.split_envelope(raw)
-                    try:
-                        req, _ = wire.decode_message(payload or raw)
-                    except wire.WireError as exc:
-                        bad, _ = wire.encode_message(
-                            {"ok": False, "bad_frame": True,
-                             "replica_id": self.replica_id,
-                             "error": str(exc)})
-                        sock.send_multipart(list(envelope) + bad)
-                        continue
-                    rep = self._answer(req)
-                    if rep is None:
-                        continue            # blackholed
-                    out, _ = wire.encode_message(rep)
-                    sock.send_multipart(list(envelope) + out,
-                                        copy=False)
+
+            loop.register(sock, on_data, drain=True)
+            loop.register(hb, lambda _frames: None,  # acks discarded
+                          drain=True)
+            loop.add_tick(beat)
+            beat()                          # first heartbeat pre-poll
+            self._ready.set()
+            loop.run(poll_ms=5)
         finally:
-            sock.close(0)
-            hb.close(0)
+            loop.close()
 
 
 # -- process-level kill harness ------------------------------------------------
@@ -855,45 +952,46 @@ def take_job_and_die(endpoint: str, workflow, slave_id: str = "doomed",
     re-register on a timeout, a corrupted reply, or a ``bad_frame``
     refusal of its own corrupted frame, bounded by ``attempts``) — when
     driven through the ChaosProxy its frames get corrupted like
-    anyone else's, and the doomed slave must still reach its job."""
-    import zmq
-
+    anyone else's, and the doomed slave must still reach its job.
+    Rides the shared :class:`~znicz_tpu.transport.Endpoint` (ISSUE 14),
+    like every other client link."""
     from znicz_tpu.network_common import handshake_request
-    from znicz_tpu.parallel import wire
+    from znicz_tpu.transport import Endpoint, TransportFault
 
-    ctx = zmq.Context.instance()
+    ep = Endpoint(endpoint, recv_timeout_s=timeout_ms / 1000.0)
     last: Optional[BaseException] = None
-    for _ in range(attempts):
-        sock = ctx.socket(zmq.REQ)
-        sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
-        sock.setsockopt(zmq.LINGER, 0)
-        sock.connect(endpoint)
 
-        def rpc(msg: dict) -> dict:
-            frames, _ = wire.encode_message(dict(msg, id=slave_id))
-            sock.send_multipart(frames)
-            return wire.decode_message(sock.recv_multipart())[0]
+    def rpc(msg: dict) -> dict:
+        return ep.rpc_message(dict(msg, id=slave_id))
 
-        try:
-            rep = rpc(handshake_request(workflow))
-            if rep.get("bad_frame"):
-                continue        # our register corrupted in flight: retry
-            if not rep.get("ok"):
-                raise RuntimeError(
-                    f"registration refused: {rep.get('error')}")
-            while True:
-                rep = rpc({"cmd": "job"})
-                if "job" in rep:
-                    return rep["job_id"]
-                if rep.get("done"):
-                    return None
-                if rep.get("unregistered"):
-                    break       # master lost us: fresh cycle, re-register
-                time.sleep(0.05)
-        except (zmq.Again, wire.WireError) as exc:
-            last = exc          # EFSM-broken socket: reconnect fresh
-        finally:
-            sock.close(0)               # died mid-job, update never sent
+    try:
+        for _ in range(attempts):
+            try:
+                rep = rpc(handshake_request(workflow))
+                if rep.get("bad_frame"):
+                    # our register corrupted in flight: fresh cycle
+                    # (fresh socket too — REQ_RELAXED would allow
+                    # reuse, but the historical fresh-socket retry is
+                    # what the chaos accounting was calibrated on)
+                    ep.reset()
+                    continue
+                if not rep.get("ok"):
+                    raise RuntimeError(
+                        f"registration refused: {rep.get('error')}")
+                while True:
+                    rep = rpc({"cmd": "job"})
+                    if "job" in rep:
+                        return rep["job_id"]
+                    if rep.get("done"):
+                        return None
+                    if rep.get("unregistered"):
+                        ep.reset()
+                        break   # master lost us: fresh cycle, re-register
+                    time.sleep(0.05)
+            except TransportFault as exc:
+                last = exc      # socket already reset: reconnect fresh
+    finally:
+        ep.close()              # died mid-job, update never sent
     raise RuntimeError(
         f"doomed slave never reached a job through the chaos "
         f"({attempts} attempts; last fault: {last!r})")

@@ -164,6 +164,9 @@ class Relay:
         "relay_jobs_served": "jobs served to children",
         "relay_upstream_reconnects": "fresh-socket retries upstream",
         "relay_rehomes": "upstream re-homes to the advertised fallback",
+        # unified transport core (ISSUE 14): deadline propagation
+        "relay_jobs_expired": "queued jobs dropped unserved: deadline "
+                              "budget spent (master re-queues them)",
     }
 
     def __init__(self, upstream: str, bind: str,
@@ -255,8 +258,12 @@ class Relay:
         self._uregistered = False
         self._ufails = 0
         self._urefusals = 0             # consecutive bad_frame replies
-        self._usock = None
         self._last_evict = 0.0
+        #: optional FaultSchedule for the serve loop's built-in ingress
+        #: fault hook (ISSUE 14 cross-plane soak); the live loop is on
+        #: ``_transport`` while serving
+        self.transport_chaos = None
+        self._transport = None
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -264,6 +271,19 @@ class Relay:
         sc = telemetry.scope("relay", bind=str(bind))
         self._m = {name: sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
+        # the upstream link rides the shared transport Endpoint (ISSUE
+        # 14): fresh-socket reconnect + resend-same-bytes; the backoff
+        # curve keeps the relay's historical constants (base 0.05s,
+        # cap 2s, exponent cap 5).  No breaker on this plane: the
+        # bounded budget + rehome-one-rung-up policy IS its fail-fast.
+        from znicz_tpu.transport import Endpoint, RetryPolicy
+        self._uep = Endpoint(
+            self.upstream, recv_timeout_s=self.recv_timeout,
+            retry=RetryPolicy.for_relay_upstream(
+                self.max_reconnects,
+                jitter_key=f"{self.relay_id}/backoff"),
+            count_out=self._m["relay_bytes_out"].inc,
+            count_in=self._m["relay_bytes_in"].inc)
         from znicz_tpu.telemetry.metrics import weak_fn
 
         sc.gauge("relay_children", "children registered at this relay",
@@ -316,6 +336,7 @@ class Relay:
             "bad_frames": self.bad_frames,
             "upstream_reconnects": self.upstream_reconnects,
             "rehomes": self.rehomes,
+            "jobs_expired": self.jobs_expired,
         }
 
     # -- child-side edge validation (the quarantine mirror) --------------------
@@ -485,12 +506,39 @@ class Relay:
                     self._wait_until = time.time() + damp
                 return {"wait": True}
             params = rep.get("params")
+            from znicz_tpu.transport import local_deadline
+            now = time.monotonic()
             with self._lock:
                 self._wait_streak = 0
-                self._jobq.extend((dict(j), params) for j in jobs)
+                for j in jobs:
+                    entry = dict(j)
+                    # deadline propagation (ISSUE 14): the budget the
+                    # master stamped becomes a LOCAL absolute deadline
+                    # at receipt — it burns while the job queues here
+                    entry["_deadline_t"] = local_deadline(
+                        entry.get("deadline_ms"), now=now)
+                    self._jobq.append((entry, params))
+        from znicz_tpu.transport import remaining_ms
+        now = time.monotonic()
+        take: List[Tuple[dict, Any]] = []
+        expired = 0
         with self._lock:
-            take = self._jobq[:k]
-            del self._jobq[:k]
+            while self._jobq and len(take) < k:
+                entry, params = self._jobq.pop(0)
+                deadline = entry.pop("_deadline_t", None)
+                if deadline is not None and now > deadline:
+                    # expired while queued: drop UNSERVED — the master
+                    # has (or will have) re-queued it, so serving it
+                    # would burn a child's compute on wasted work
+                    # (PR 6's "expired work never computed", ISSUE 14)
+                    expired += 1
+                    continue
+                if deadline is not None:
+                    # re-stamp the REMAINING budget for the child
+                    entry["deadline_ms"] = remaining_ms(deadline, now)
+                take.append((entry, params))
+        if expired:
+            self._m["relay_jobs_expired"].inc(expired)
         if not take:
             return {"wait": True}
         self._m["relay_jobs_served"].inc(len(take))
@@ -650,46 +698,30 @@ class Relay:
             with self._lock:
                 self._done = True
 
-    # -- the upstream link -----------------------------------------------------
-
-    def _connect_upstream(self):
-        import zmq
-
-        sock = zmq.Context.instance().socket(zmq.REQ)
-        sock.setsockopt(zmq.REQ_RELAXED, 1)
-        sock.setsockopt(zmq.REQ_CORRELATE, 1)
-        sock.setsockopt(zmq.RCVTIMEO, int(self.recv_timeout * 1000))
-        sock.setsockopt(zmq.LINGER, 0)
-        sock.connect(self.upstream)
-        return sock
+    # -- the upstream link (rides the shared Endpoint, ISSUE 14) ---------------
 
     def _upstream_rpc(self, msg: Optional[dict] = None,
                       frames: Optional[List] = None,
                       is_register: bool = False,
                       one_shot: bool = False) -> Optional[dict]:
-        """One REQ/REP exchange with the upstream, riding the client's
-        fault model: a timeout or undecodable reply closes the (EFSM-
-        broken) socket, backs off and reconnects fresh — re-registering
-        with the cached credentials before any further traffic — and
-        re-sends the SAME frames.  Returns None once the reconnect
-        budget is spent (the caller treats the upstream as gone).
-        ``one_shot`` permits a single attempt even after ``stop()`` —
-        the serve loop's final flush."""
-        import random
-
-        import zmq
-
+        """One REQ/REP exchange with the upstream, riding the shared
+        client fault model (:class:`~znicz_tpu.transport.Endpoint`): a
+        timeout or undecodable reply drops the (EFSM-broken) socket,
+        backs off on the relay's historical curve and reconnects fresh
+        — re-registering with the cached credentials before any further
+        traffic — and re-sends the SAME frames.  Returns None once the
+        reconnect budget is spent (the caller treats the upstream as
+        gone).  ``one_shot`` permits a single attempt even after
+        ``stop()`` — the serve loop's final flush."""
         from znicz_tpu.parallel import wire
+        from znicz_tpu.transport import TransportFault
 
         if frames is None:
             frames, _ = wire.encode_message(msg)
-        rng = random.Random(f"{self.relay_id}/backoff/{self._ufails}")
         attempts = 0
         while not self._stop.is_set() or (one_shot and attempts == 0):
             attempts += 1
             try:
-                if self._usock is None:
-                    self._usock = self._connect_upstream()
                 if not self._uregistered and not is_register:
                     cred = self._cred
                     if cred is None:
@@ -699,7 +731,7 @@ class Relay:
                          "version": cred[0], "workflow_digest": cred[1],
                          "relay": True, "fanout": self.fanout,
                          "bind": self.bind})
-                    rep = self._exchange(reg)
+                    rep = self._uep.rpc(reg)
                     if rep.get("bad_frame"):
                         if self._count_refusal():
                             return None
@@ -717,7 +749,7 @@ class Relay:
                         # own fallback advertisement
                         self._upstream_fallback = rep.get("upstream")
                     self._uregistered = True
-                rep = self._exchange(frames)
+                rep = self._uep.rpc(frames)
                 self._ufails = 0
                 if rep.get("bad_frame"):
                     # the upstream is alive but never decoded our frame
@@ -732,12 +764,9 @@ class Relay:
                     self._uregistered = False   # master restarted
                     continue                    # re-register + resend
                 return rep
-            except (zmq.Again, wire.WireError, TypeError) as exc:
+            except TransportFault as exc:
                 self._ufails += 1
                 self._m["relay_upstream_reconnects"].inc()
-                if self._usock is not None:
-                    self._usock.close(0)
-                    self._usock = None
                 self._uregistered = False
                 if self._ufails > self.max_reconnects:
                     import logging
@@ -757,6 +786,7 @@ class Relay:
                         else:
                             fallback = None
                     if fallback:
+                        self._uep.endpoint = fallback
                         self._m["relay_rehomes"].inc()
                         self._ufails = 0
                         logging.getLogger("znicz").warning(
@@ -772,8 +802,7 @@ class Relay:
                         self._ufails - 1, exc)
                     self._stop.set()
                     return None
-                delay = min(2.0, 0.05 * (2 ** min(self._ufails - 1, 5)))
-                time.sleep(delay * (0.5 + rng.random()))
+                self._uep.backoff(self._ufails)
         return None
 
     def _count_refusal(self) -> bool:
@@ -792,23 +821,6 @@ class Relay:
             "relay going silent", self.relay_id, self._urefusals)
         self._stop.set()
         return True
-
-    def _exchange(self, frames: List) -> dict:
-        """send/recv one frame stack on the live upstream socket; raises
-        zmq.Again / WireError / TypeError on faults (handled by the rpc
-        retry loop)."""
-        from znicz_tpu.parallel import wire
-
-        self._m["relay_bytes_out"].inc(
-            sum(f.nbytes if isinstance(f, memoryview) else len(f)
-                for f in frames))
-        self._usock.send_multipart(frames, copy=False)
-        raw = self._usock.recv_multipart()
-        self._m["relay_bytes_in"].inc(sum(len(f) for f in raw))
-        rep, _ = wire.decode_message(raw)
-        if not isinstance(rep, dict):
-            raise TypeError(f"reply decodes to {type(rep).__name__}")
-        return rep
 
     # -- the serve loop --------------------------------------------------------
 
@@ -829,10 +841,10 @@ class Relay:
                     f"decodes to {type(req).__name__}, not a request "
                     f"dict")
         except Exception as exc:
+            from znicz_tpu.transport import bad_frame_reply
+
             self._m["relay_bad_frames"].inc()
-            rep_frames = [pickle.dumps(
-                {"ok": False, "bad_frame": True,
-                 "error": f"bad frame: {exc}"})]
+            rep_frames = [pickle.dumps(bad_frame_reply(exc))]
             self._m["relay_bytes_out"].inc(
                 sum(len(f) for f in rep_frames))
             return rep_frames
@@ -860,41 +872,48 @@ class Relay:
     def serve(self, linger: float = 3.0) -> None:
         """Blocks until the upstream reports done (then keeps draining
         ``linger`` seconds so late children get their ``done``) or
-        ``stop()``.  Binds lazily with the master's EADDRINUSE retry so
-        a restarted relay can race its predecessor's port release."""
-        import zmq
+        ``stop()``.  Rides the unified
+        :class:`~znicz_tpu.transport.TransportLoop` (ISSUE 14): REP
+        lockstep dispatch of :meth:`_reply_frames` plus one idle tick
+        for flushes, child eviction and the drain linger.  The loop
+        keeps its OWN stop flag so a linger-exit leaves ``self._stop``
+        unset and the final flush retains its full retry budget."""
+        from znicz_tpu.transport import TransportLoop
 
-        from znicz_tpu.network_common import bind_with_retry, make_poller
+        loop = TransportLoop("relay", instance=self.bind)
+        state = {"deadline": None}
 
-        ctx = zmq.Context.instance()
-        sock = ctx.socket(zmq.REP)
-        bind_with_retry(sock, self.bind)
-        self._ready.set()
-        poller = make_poller(sock)
-        deadline = None
+        def tick() -> None:
+            if self._stop.is_set():
+                loop.stop()
+                return
+            with self._lock:
+                done = self._done and not self._buffer
+            if done and state["deadline"] is None:
+                state["deadline"] = time.time() + linger
+            if state["deadline"] is not None \
+                    and time.time() > state["deadline"]:
+                loop.stop()
+                return
+            self._maybe_flush()
+            self._evict_children()
+
         try:
-            while not self._stop.is_set():
-                with self._lock:
-                    done = self._done and not self._buffer
-                if done and deadline is None:
-                    deadline = time.time() + linger
-                if deadline is not None and time.time() > deadline:
-                    break
-                if poller.poll(20):
-                    frames = sock.recv_multipart()
-                    sock.send_multipart(self._reply_frames(frames),
-                                        copy=False)
-                self._maybe_flush()
-                self._evict_children()
+            sock = loop.bind_rep(self.bind)
+            loop.register(sock, self._reply_frames, reply=True)
+            if self.transport_chaos is not None:
+                loop.inject_faults(self.transport_chaos)
+            self._transport = loop
+            loop.add_tick(tick)
+            self._ready.set()
+            loop.run(poll_ms=20)
         finally:
             # one delivery attempt even when stop() ended the loop — a
             # clean shutdown should not drop a window a healthy
             # upstream would take (undeliverable: the TTL reaper pays)
             self._flush(final=True)
-            sock.close(0)
-            if self._usock is not None:
-                self._usock.close(0)
-                self._usock = None
+            loop.close()
+            self._uep.close()
 
     def start(self, linger: float = 3.0) -> "Relay":
         self._thread = threading.Thread(

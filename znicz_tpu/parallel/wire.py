@@ -565,14 +565,18 @@ class Codec:
         owner's handler (the owner's half of the fault accounting)."""
         self._m["bad_frames"].inc()
 
-    def refusal(self, error: str, legacy: bool = True, **extra) -> List:
+    def refusal(self, cause, legacy: bool = True, **extra) -> List:
         """The counted bad-frame refusal reply: ``bad_frames`` ticks and
         the reply defaults to LEGACY framing — an undecodable request's
         peer format is unknown, and a single pickle is the one framing
-        every protocol revision can read."""
+        every protocol revision can read.  The payload (slug + wording)
+        comes from the transport core's ``bad_frame_reply`` — ONE home,
+        every plane (ISSUE 14)."""
+        from znicz_tpu.transport.core import bad_frame_reply
+
         self._m["bad_frames"].inc()
-        return self.encode({"ok": False, "bad_frame": True,
-                            "error": error, **extra}, legacy=legacy)
+        return self.encode(dict(bad_frame_reply(cause), **extra),
+                           legacy=legacy)
 
     def compression_ratio(self, direction: str = "both"
                           ) -> Optional[float]:

@@ -141,6 +141,11 @@ class Server:
         self._job_seq = 0
         self._hold = None                           # segment-overshoot mb
         self._socket = None
+        self._transport = None          # the serve()-time TransportLoop
+        #: optional FaultSchedule for the transport loop's BUILT-IN
+        #: ingress fault hook (ISSUE 14) — the cross-plane chaos soak
+        #: installs ONE seeded schedule on every plane through this
+        self.transport_chaos = None
         self._stop = False
         #: silent-slave eviction window, seconds (<= 0 disables); evicted
         #: ids keep their jobs_by_slave history for the final report
@@ -193,6 +198,26 @@ class Server:
         #: accepted delta apply (job replies are stamped with it; the
         #: slave echoes the stamp back with its update)
         self._apply_step = 0
+        # -- unified transport core (ISSUE 14) --------------------------
+        #: per-slave ingress admission — the serving plane's TokenBucket
+        #: lifted to the master (transport/admission.py): a slave
+        #: flooding JOB requests past ``ingress_rate_limit``/s is
+        #: answered ``wait`` (counted ``rate_limited_ingress``, never
+        #: fatal, never a membership strike) instead of monopolizing
+        #: the REP loop.  UPDATES are always admitted: they carry
+        #: finished work, and refusing one would trash the compute
+        #: behind it.  0 disables (the default — a cooperative fleet).
+        from znicz_tpu.transport import AdmissionTable
+        self._ingress = AdmissionTable(
+            rate=float(root.common.engine.get("ingress_rate_limit", 0.0)),
+            burst=float(root.common.engine.get("ingress_rate_burst", 0.0)))
+        #: training-job deadline propagation (ISSUE 14): every job is
+        #: stamped with a ``deadline_ms`` BUDGET (= the live reap
+        #: timeout — past it the master re-queues the job anyway, so
+        #: computing it is pure waste); slaves and relays drop expired
+        #: jobs uncomputed.  PR 6's serving contract, fleet-wide.
+        self.job_deadline = bool(
+            root.common.engine.get("job_deadline", True))
         #: per-relay subtree leaf counts, reported by relays on their
         #: job requests (``leaves``) — the quorum's view through trees
         self._relay_leaves: Dict[str, int] = {}
@@ -285,6 +310,9 @@ class Server:
         "weighted_applies": "applies scaled down by staleness",
         "replans": "runtime tree re-plans (relay membership changes)",
         "preemptions_ridden": "members lost mid-run and ridden out",
+        # unified transport core (ISSUE 14)
+        "rate_limited_ingress": "job requests answered wait: per-slave "
+                                "ingress rate limit",
     }
 
     # (the historical attribute properties are generated from COUNTERS
@@ -793,6 +821,7 @@ class Server:
                 "weighted_applies": self.weighted_applies,
                 "replans": self.replans,
                 "preemptions_ridden": self.preemptions_ridden,
+                "rate_limited_ingress": self.rate_limited_ingress,
                 "tensor_bytes_raw_in": self.tensor_bytes_raw_in,
                 "tensor_bytes_wire_in": self.tensor_bytes_wire_in,
                 "tensor_bytes_raw_out": self.tensor_bytes_raw_out,
@@ -876,42 +905,55 @@ class Server:
         """Blocks until the decision completes, then keeps draining for
         ``linger`` seconds so every slave's outstanding request gets a
         ``done`` reply (a request sent the instant training finished must
-        not be orphaned — the slave would block in recv forever)."""
-        import zmq
+        not be orphaned — the slave would block in recv forever).
 
-        from znicz_tpu.network_common import bind_with_retry, make_poller
+        Rides the unified :class:`~znicz_tpu.transport.TransportLoop`
+        (ISSUE 14): REP lockstep dispatch of :meth:`_reply_frames`
+        (copy=False — reply tensor frames are memoryviews of
+        snapshot_params' fresh copies, never mutated later) plus one
+        idle tick for the reap/evict/resume/drain-linger work."""
+        from znicz_tpu.transport import TransportLoop
 
-        ctx = zmq.Context.instance()
         self._stop = False
-        self._socket = ctx.socket(zmq.REP)
-        bind_with_retry(self._socket, self.endpoint)
-        poller = make_poller(self._socket)
-        deadline = None
+        loop = self._transport = TransportLoop("master",
+                                       instance=self.endpoint)
+        state = {"deadline": None}
+
+        def tick() -> None:
+            if self._stop:
+                loop.stop()
+                return
+            if bool(self.decision.complete):
+                # jobs still out with crashed slaves will never be
+                # re-served — reap on timeout and drop, else serve()
+                # would poll forever waiting on a dead peer
+                self._reap_lost_jobs()
+                self._pending.clear()
+            finished = (bool(self.decision.complete)
+                        and not self._inflight and not self._pending)
+            if finished and state["deadline"] is None:
+                state["deadline"] = time.time() + linger
+            if state["deadline"] is not None \
+                    and time.time() > state["deadline"]:
+                loop.stop()
+                return
+            self._evict_dead_slaves()
+            self._maybe_save_resume()
+
         try:
-            while not self._stop:
-                if bool(self.decision.complete):
-                    # jobs still out with crashed slaves will never be
-                    # re-served — reap on timeout and drop, else serve()
-                    # would poll forever waiting on a dead peer
-                    self._reap_lost_jobs()
-                    self._pending.clear()
-                finished = (bool(self.decision.complete)
-                            and not self._inflight and not self._pending)
-                if finished and deadline is None:
-                    deadline = time.time() + linger
-                if deadline is not None and time.time() > deadline:
-                    break
-                self._evict_dead_slaves()
-                self._maybe_save_resume()
-                if poller.poll(100):
-                    frames = self._socket.recv_multipart()
-                    rep_frames = self._reply_frames(frames)
-                    # copy=False: reply tensor frames are memoryviews of
-                    # snapshot_params' fresh copies, never mutated later
-                    self._socket.send_multipart(rep_frames, copy=False)
+            self._socket = loop.bind_rep(self.endpoint)
+            loop.register(self._socket, self._reply_frames, reply=True)
+            if self.transport_chaos is not None:
+                loop.inject_faults(self.transport_chaos)
+            loop.add_tick(tick)
+            tick()                      # pre-poll pass (resume cadence)
+            loop.run(poll_ms=100)
         finally:
-            self._socket.close(0)
+            loop.close()
             self._socket = None
+            # _transport intentionally KEEPS the closed loop: the
+            # cross-plane soak reads its message/fault accounting
+            # post-run
             if (self.resume_path and not self._stop
                     and bool(self.decision.complete)
                     and os.path.exists(self.resume_path)):
@@ -940,7 +982,7 @@ class Server:
                 raise wire.WireError(
                     f"decodes to {type(req).__name__}, not a request dict")
         except Exception as exc:
-            rep_frames = self.codec.refusal(f"bad frame: {exc}")
+            rep_frames = self.codec.refusal(exc)
             logging.getLogger("znicz").warning(
                 "refused undecodable message (%d frames, %d bytes): %s "
                 "— bad_frames=%d", len(frames),
@@ -1025,16 +1067,33 @@ class Server:
                     "error": f"slave {sid!r} is not registered"}
         if cmd == "job":
             if bool(self.decision.complete):
-                return {"done": True}
+                return {"done": True}       # terminal — never throttled
             if sid in self.relays and req.get("leaves") is not None:
-                # relays piggyback their live subtree LEAF count on
-                # every job request (ISSUE 11) — the quorum's view
-                # through trees, self-healing: a dead subtree stops
-                # polling and its count ages out with its relay
+                # the quorum membership piggyback is read BEFORE the
+                # rate limit below: a throttled relay's refused
+                # requests must still refresh its subtree leaf count,
+                # or /readyz and the --min-slaves gate would hold a
+                # stale view exactly while the fleet is under load
                 try:
                     self._relay_leaves[sid] = max(0, int(req["leaves"]))
                 except (TypeError, ValueError):
                     pass
+            if not self._ingress.try_take(sid):
+                # per-slave ingress admission (ISSUE 14): the serving
+                # plane's token bucket on the master's door.  Refused
+                # as ``wait`` — the slave's existing poll_sleep path —
+                # so a misbehaving flood is throttled, counted, and
+                # NEVER fatal (no strike, no eviction; its finished
+                # updates are still taken below).
+                self._m["rate_limited_ingress"].inc()
+                return {"wait": True, "rate_limited": True,
+                        "policy": "rate_limited",
+                        "error": f"slave {sid!r} is over the per-slave "
+                                 f"ingress rate limit "
+                                 f"({self._ingress.rate:g} job "
+                                 f"requests/s)"}
+            # (the relay ``leaves`` piggyback — ISSUE 11's quorum view
+            # through trees — was already read above, pre-admission)
             if not self.quorum_met():
                 # quorum gate (ISSUE 11): below min_slaves the master
                 # PAUSES dispatch — peers wait (and re-ask) instead of
@@ -1066,10 +1125,18 @@ class Server:
                 # params version this job computes against; the slave
                 # echoes it with its update, and the delta's staleness
                 # is the applies elapsed since
-                entries.append({"job_id": jid, "job": job,
-                                "trace_id": f"{self._run_tag}-{jid}",
-                                "train": job["class"] == TRAIN,
-                                "step": self._apply_step})
+                entry = {"job_id": jid, "job": job,
+                         "trace_id": f"{self._run_tag}-{jid}",
+                         "train": job["class"] == TRAIN,
+                         "step": self._apply_step}
+                if self.job_deadline:
+                    # deadline propagation (ISSUE 14): a BUDGET, not a
+                    # timestamp (clocks differ) — the live reap window:
+                    # past it the job is re-queued here anyway, so a
+                    # slave/relay must drop it instead of computing it
+                    entry["deadline_ms"] = \
+                        self.effective_job_timeout() * 1e3
+                entries.append(entry)
             if not entries:
                 if job is self._WAIT:
                     return {"wait": True}   # client sleeps and re-asks

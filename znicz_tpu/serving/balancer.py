@@ -206,6 +206,11 @@ class ReplicaBalancer:
         self._serve_error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self.started_at: Optional[float] = None
+        #: optional FaultSchedule for the serve loop's built-in ingress
+        #: fault hook (ISSUE 14 cross-plane soak); the live
+        #: TransportLoop sits on ``_transport`` while serving
+        self.transport_chaos = None
+        self._transport = None
         self.log = logging.getLogger("znicz.balancer")
 
     # -- registry-backed counters under their historical names (props
@@ -342,84 +347,71 @@ class ReplicaBalancer:
     # -- the serve loop --------------------------------------------------------
 
     def _serve(self) -> None:
-        import zmq
+        from znicz_tpu.transport import TransportLoop
 
-        from znicz_tpu.network_common import bind_with_retry, make_poller
-
-        ctx = zmq.Context.instance()
-        front = ctx.socket(zmq.ROUTER)
-        front.setsockopt(zmq.LINGER, 0)
-        bind_with_retry(front, self.bind)
-        self.endpoint = front.getsockopt(zmq.LAST_ENDPOINT).decode()
-        self.started_at = time.perf_counter()
-        #: endpoint -> data DEALER (serve-thread-owned, like the codec)
+        loop = self._transport = TransportLoop(
+            "balancer", stop=self._stop, instance=self.bind)
+        if self.transport_chaos is not None:
+            loop.inject_faults(self.transport_chaos)
+        #: endpoint -> data DEALER (serve-thread-owned, like the codec;
+        #: reply routing rides each socket's registered closure)
         data: Dict[str, object] = {}
-        by_sock: Dict[object, str] = {}
-        poller = make_poller(front)
-
-        def data_sock(endpoint: str):
-            sock = data.get(endpoint)
-            if sock is None:
-                sock = ctx.socket(zmq.DEALER)
-                sock.setsockopt(zmq.LINGER, 0)
-                sock.connect(endpoint)
-                data[endpoint] = sock
-                by_sock[sock] = endpoint
-                poller.register(sock, zmq.POLLIN)
-            return sock
-
-        def drop_unused_data_socks(live_endpoints) -> None:
-            # endpoint churn (wildcard-bind replicas get a fresh port
-            # per restart): a socket no member references anymore would
-            # otherwise leak an fd + poller registration per restart
-            for ep in [ep for ep in data
-                       if ep not in live_endpoints
-                       and ep not in self.static_replicas]:
-                sock = data.pop(ep)
-                by_sock.pop(sock, None)
-                poller.unregister(sock)
-                sock.close(0)
-
-        for ep in self.static_replicas:
-            data_sock(ep)
-        self._data_sock = data_sock         # serve-thread closures for
-        self._front = front                 # the helpers below
-        self._drop_unused_data_socks = drop_unused_data_socks
-        self._ready.set()
         try:
-            while not self._stop.is_set():
+            front = loop.bind_router(self.bind)
+            self.endpoint = loop.resolved_endpoint(front)
+            self.started_at = time.perf_counter()
+
+            def data_sock(endpoint: str):
+                sock = data.get(endpoint)
+                if sock is None:
+                    sock = loop.connect_dealer(endpoint)
+                    data[endpoint] = sock
+                    # replica replies drain BEFORE new client requests
+                    # (priority 0 < the front's 10): a reply frees its
+                    # ledger slot, so dispatch weighs loads that are
+                    # current, not one tick stale
+                    loop.register(
+                        sock,
+                        lambda frames, _ep=endpoint:
+                        self._handle_replica(_ep, frames),
+                        drain=True, priority=0)
+                return sock
+
+            def drop_unused_data_socks(live_endpoints) -> None:
+                # endpoint churn (wildcard-bind replicas get a fresh
+                # port per restart): a socket no member references
+                # anymore would otherwise leak an fd + poller
+                # registration per restart
+                for ep in [ep for ep in data
+                           if ep not in live_endpoints
+                           and ep not in self.static_replicas]:
+                    sock = data.pop(ep)
+                    loop.unregister(sock)   # also closes it
+
+            for ep in self.static_replicas:
+                data_sock(ep)
+            self._data_sock = data_sock     # serve-thread closures for
+            self._front = front             # the helpers below
+            self._drop_unused_data_socks = drop_unused_data_socks
+            loop.register(front, self._handle_front, drain=True,
+                          priority=10)
+
+            def tick() -> None:
                 if self.max_requests is not None and \
                         self.replied + self.refused >= self.max_requests:
-                    break
-                events = dict(poller.poll(5))
-                # replica replies BEFORE new requests: a reply frees
-                # its ledger slot, so the dispatch below weighs loads
-                # that are current, not one tick stale
-                for sock, ep in list(by_sock.items()):
-                    if sock not in events:
-                        continue
-                    while True:
-                        try:
-                            frames = sock.recv_multipart(zmq.NOBLOCK)
-                        except zmq.Again:
-                            break
-                        self._handle_replica(ep, frames)
-                if front in events:
-                    while True:
-                        try:
-                            frames = front.recv_multipart(zmq.NOBLOCK)
-                        except zmq.Again:
-                            break
-                        self._handle_front(frames)
+                    loop.stop()
+                    return
                 with self._lock:
                     self._tick_membership()
                     self._tick_inflight()
                     self._tick_rollover()
+
+            loop.add_tick(tick)
+            self._ready.set()
+            loop.run(poll_ms=5)
         finally:
             self._stop.set()
-            front.close(0)
-            for sock in data.values():
-                sock.close(0)
+            loop.close()
 
     # -- front plane: clients + heartbeats -------------------------------------
 
@@ -452,7 +444,7 @@ class ReplicaBalancer:
         except wire.WireError as exc:
             self.log.warning("refused undecodable front message: %s", exc)
             self._send_front(envelope, self.codec.refusal(
-                f"bad frame: {exc}", legacy=False, lb=True))
+                exc, legacy=False, lb=True))
             return
         self.codec.count_message_in(payload)
         cmd = skel.get("cmd")

@@ -145,44 +145,10 @@ class Refusal(str):
         return self
 
 
-class TokenBucket:
-    """Per-client rate limiter: ``rate`` rows/s refill into a bucket of
-    ``burst`` rows capacity; a submit takes its row count or is refused.
-    Burst admits a cold client's first flurry; sustained traffic is
-    capped at ``rate``."""
-
-    __slots__ = ("rate", "burst", "tokens", "t_last")
-
-    def __init__(self, rate: float, burst: float):
-        self.rate = float(rate)
-        self.burst = float(burst)
-        self.tokens = float(burst)
-        self.t_last = time.perf_counter()
-
-    def try_take(self, n: int) -> bool:
-        now = time.perf_counter()
-        self.tokens = min(self.burst,
-                          self.tokens + (now - self.t_last) * self.rate)
-        self.t_last = now
-        if self.tokens >= n:
-            self.tokens -= n
-            return True
-        return False
-
-    def refund(self, n: int) -> None:
-        """Return ``n`` taken tokens (a later admission stage refused
-        the request): a shed must not ALSO burn the client's rate
-        budget, or a recovering client gets rate_limited refusals it
-        never earned."""
-        self.tokens = min(self.burst, self.tokens + n)
-
-    def is_full(self, now: float) -> bool:
-        """True when the bucket has refilled to capacity — state
-        identical to a freshly built bucket, so it can be dropped and
-        lazily rebuilt without the client noticing."""
-        return min(self.burst,
-                   self.tokens + (now - self.t_last) * self.rate) \
-            >= self.burst
+# the per-client rate limiter now lives in the transport core (ISSUE
+# 14) so the MASTER's ingress meters per-slave rates with the SAME
+# primitive; re-exported here under its historical home
+from znicz_tpu.transport.admission import TokenBucket        # noqa: E402
 
 
 class AdmissionPolicy:
@@ -301,7 +267,6 @@ class DynamicBatcher:
         self._visiting = _NO_VISIT          # DRR visit marker (quantum
         #                                     banks once per visit)
         self._client_rows: Dict[object, int] = {}
-        self._buckets: Dict[object, TokenBucket] = {}
         #: bounded per-client admission accounting for the panel
         self.clients: "collections.OrderedDict[str, Dict]" \
             = collections.OrderedDict()
@@ -339,12 +304,21 @@ class DynamicBatcher:
         token buckets restart (new rates must not inherit old debt).
         Already-queued requests drain under the rotation regardless —
         only the submit-side keying/limits change."""
+        from znicz_tpu.transport import AdmissionTable
+
         with self._cond:
             self.admission = policy
             self._rate_burst = policy.rate_burst or max(
                 policy.rate_limit, float(self.max_batch))
             self._quantum = policy.quantum or max(1, self.max_batch // 4)
-            self._buckets.clear()
+            # the bounded per-client bucket table is the transport
+            # core's (ISSUE 14 — ONE home for the lazy-build /
+            # lossless-sweep / oldest-first-eviction discipline, shared
+            # with the master's ingress); rebuilt so new rates never
+            # inherit old debt
+            self._table = AdmissionTable(policy.rate_limit,
+                                         self._rate_burst,
+                                         max_peers=self.MAX_BUCKETS)
 
     @property
     def _client_bound(self) -> int:
@@ -353,18 +327,6 @@ class DynamicBatcher:
         runtime cannot leave a stale fair-share bound above the whole
         queue."""
         return self.admission.client_queue_bound or self.queue_bound
-
-    def _sweep_buckets(self) -> None:
-        """Bound the token-bucket table (cond held).  Refilled-to-full
-        buckets are indistinguishable from freshly built ones, so
-        dropping them is lossless for their clients; only if ALL
-        buckets are mid-debt (more simultaneously active clients than
-        MAX_BUCKETS) does oldest-first eviction lose state."""
-        now = time.perf_counter()
-        for k in [k for k, b in self._buckets.items() if b.is_full(now)]:
-            del self._buckets[k]
-        while len(self._buckets) >= self.MAX_BUCKETS:
-            del self._buckets[next(iter(self._buckets))]
 
     def _client_stat(self, client) -> Dict:
         key = str(client)
@@ -412,19 +374,13 @@ class DynamicBatcher:
             if self._closed:
                 return Refusal("draining", "service is shutting down")
             key = None
-            bucket = None
+            took = 0
             if adm.enabled:
                 st = self._client_stat(req.client)
                 st["requests"] += 1
                 st["rows"] += req.n
                 if adm.rate_limit > 0:
-                    bucket = self._buckets.get(req.client)
-                    if bucket is None:
-                        if len(self._buckets) >= self.MAX_BUCKETS:
-                            self._sweep_buckets()
-                        bucket = self._buckets[req.client] = TokenBucket(
-                            adm.rate_limit, self._rate_burst)
-                    if not bucket.try_take(req.n):
+                    if not self._table.try_take(req.client, req.n):
                         self._m["rate_limited"].inc()
                         st["rate_limited"] += 1
                         return Refusal(
@@ -433,6 +389,7 @@ class DynamicBatcher:
                             f"({adm.rate_limit:g} rows/s, burst "
                             f"{self._rate_burst:g}) — rate_limited",
                             scope="client")
+                    took = req.n
                 if adm.fair:
                     key = req.client
                     # explicit per-client cap only: with
@@ -444,8 +401,8 @@ class DynamicBatcher:
                             > self._client_bound):
                         self._m["shed"].inc()
                         st["shed"] += 1
-                        if bucket is not None:
-                            bucket.refund(req.n)
+                        if took:
+                            self._table.refund(req.client, took)
                         return Refusal(
                             "shed",
                             f"client queue at its fair-share bound "
@@ -456,8 +413,8 @@ class DynamicBatcher:
                 self._m["shed"].inc()
                 if adm.enabled:
                     st["shed"] += 1
-                if bucket is not None:
-                    bucket.refund(req.n)
+                if took:
+                    self._table.refund(req.client, took)
                 return Refusal(
                     "shed",
                     f"queue at bound ({self._rows} rows queued, "

@@ -54,6 +54,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from znicz_tpu.telemetry.metrics import registered_property
+# the breaker now lives in the transport core (ISSUE 14) — ONE fault
+# model for every plane; re-exported here under the historical name
+from znicz_tpu.transport import (CircuitBreaker,            # noqa: F401
+                                 CircuitOpenError, RetryPolicy)
 
 
 class InferenceError(RuntimeError):
@@ -64,12 +68,6 @@ class InferenceError(RuntimeError):
     def __init__(self, reply: dict):
         super().__init__(str(reply.get("error") or reply))
         self.reply = reply
-
-
-class CircuitOpenError(RuntimeError):
-    """The client's circuit breaker is open: the request was refused
-    LOCALLY (fail-fast, no wire traffic) because the service recently
-    failed too often.  Retry after the breaker's backoff."""
 
 
 class InferenceClient:
@@ -104,27 +102,6 @@ class InferenceClient:
         #: worthless anyway); per-call ``deadline_s`` overrides
         self.deadline_s = (float(timeout) if deadline_s is None
                            else float(deadline_s))
-        # -- circuit breaker (module docstring); breaker_failures=0
-        # disables it
-        self._brk_outcomes: collections.deque = collections.deque(
-            maxlen=max(int(breaker_window), 1))
-        # clamp: a threshold above the window could never be reached
-        # (count(False) <= maxlen) — the breaker would be silently
-        # disarmed while the operator believes it is armed
-        self._brk_threshold = min(int(breaker_failures),
-                                  self._brk_outcomes.maxlen)
-        self._brk_state = "closed"
-        self._brk_until = 0.0
-        self._brk_backoff0 = float(breaker_reset_s)
-        self._brk_backoff = float(breaker_reset_s)
-        self._brk_cap = float(breaker_backoff_cap_s)
-        self._brk_probe: Optional[int] = None
-        # per-endpoint windows behind a balancer (ISSUE 12): outcome
-        # deques keyed by the reply's replica_id stamp; same window/
-        # threshold as the service breaker, bounded oldest-first
-        self._brk_replicas: "collections.OrderedDict[str, collections.deque]" \
-            = collections.OrderedDict()
-        self._brk_replica_open: Dict[str, bool] = {}
         # telemetry (ISSUE 5): client-side accounting in the registry;
         # historical attribute names preserved by generated properties
         from znicz_tpu import telemetry
@@ -132,11 +109,30 @@ class InferenceClient:
         _sc = telemetry.scope("serving_client")
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
+        # -- circuit breaker: the transport core's (ISSUE 14 — the PR 6
+        # machinery, extracted to znicz_tpu/transport/retry.py so every
+        # plane rides ONE implementation); breaker_failures=0 disables.
+        # Constants preserved: reset_s doubling to backoff_cap_s, no
+        # jitter; transition events feed the historical counters.
+        _brk_events = {"open": self._m["breaker_opens"],
+                       "short_circuit": self._m["breaker_short_circuits"],
+                       "probe": self._m["breaker_probes"]}
+        self._breaker = CircuitBreaker(
+            window=int(breaker_window), threshold=int(breaker_failures),
+            backoff=RetryPolicy.for_breaker(float(breaker_reset_s),
+                                            float(breaker_backoff_cap_s)),
+            on_event=lambda name: _brk_events[name].inc(), peer=endpoint)
+        # per-endpoint windows behind a balancer (ISSUE 12): outcome
+        # deques keyed by the reply's replica_id stamp; same window/
+        # threshold as the service breaker, bounded oldest-first
+        self._brk_replicas: "collections.OrderedDict[str, collections.deque]" \
+            = collections.OrderedDict()
+        self._brk_replica_open: Dict[str, bool] = {}
         _sc.gauge("breaker_open",
                   "circuit breaker state (0 closed, 0.5 half-open, 1 open)",
                   fn=telemetry.weak_fn(
                       self, lambda c: {"closed": 0.0, "half_open": 0.5,
-                                       "open": 1.0}[c._brk_state]))
+                                       "open": 1.0}[c._breaker.state]))
         self._ids = itertools.count(1)
         #: req_id -> [frames, t_last_sent, resends]
         self._pending: Dict[int, List] = {}
@@ -196,36 +192,13 @@ class InferenceClient:
     def breaker_state(self) -> str:
         """``closed`` / ``open`` / ``half_open`` (open flips to
         half_open lazily, at the first post-backoff submit)."""
-        return self._brk_state
+        return self._breaker.state
 
     def _breaker_admit(self) -> None:
         """Submit-side gate: fail fast while open; after the backoff,
-        let exactly ONE probe through (half-open)."""
-        if self._brk_threshold <= 0:
-            return
-        if self._brk_state == "open":
-            now = time.perf_counter()
-            if now < self._brk_until:
-                self._m["breaker_short_circuits"].inc()
-                raise CircuitOpenError(
-                    f"circuit open to {self.endpoint}: "
-                    f"{self._brk_outcomes.count(False)} failures in the "
-                    f"last {len(self._brk_outcomes)} outcomes; next "
-                    f"probe in {self._brk_until - now:.2f}s")
-            self._brk_state = "half_open"
-            self._brk_probe = None
-        if self._brk_state == "half_open" and self._brk_probe is not None:
-            self._m["breaker_short_circuits"].inc()
-            raise CircuitOpenError(
-                f"circuit half-open to {self.endpoint}: probe "
-                f"req {self._brk_probe} still in flight")
-
-    def _breaker_open(self) -> None:
-        self._brk_state = "open"
-        self._brk_until = time.perf_counter() + self._brk_backoff
-        # capped exponential growth, PR 2's reconnect-backoff idiom
-        self._brk_backoff = min(self._brk_backoff * 2, self._brk_cap)
-        self._m["breaker_opens"].inc()
+        let exactly ONE probe through (half-open) — the shared
+        transport-core breaker (ISSUE 14)."""
+        self._breaker.admit()
 
     def _replica_record(self, replica: str, ok: bool) -> None:
         """File one lb-stamped outcome into ``replica``'s own window
@@ -233,7 +206,7 @@ class InferenceClient:
         a sick replica; the client just must not open its whole-service
         breaker over it — so there is no admit gate or backoff, only
         state + an opens counter for the panel."""
-        if self._brk_threshold <= 0:
+        if not self._breaker.enabled:
             return
         win = self._brk_replicas.get(replica)
         if win is None:
@@ -241,11 +214,11 @@ class InferenceClient:
                 evicted, _ = self._brk_replicas.popitem(last=False)
                 self._brk_replica_open.pop(evicted, None)
             win = self._brk_replicas[replica] = collections.deque(
-                maxlen=self._brk_outcomes.maxlen)
+                maxlen=self._breaker.window)
         win.append(bool(ok))
         was_open = self._brk_replica_open.get(replica, False)
-        now_open = (len(win) >= self._brk_threshold
-                    and win.count(False) >= self._brk_threshold)
+        now_open = (len(win) >= self._breaker.threshold
+                    and win.count(False) >= self._breaker.threshold)
         self._brk_replica_open[replica] = now_open
         if now_open and not was_open:
             self._m["replica_breaker_opens"].inc()
@@ -266,23 +239,7 @@ class InferenceClient:
         """File one request OUTCOME.  Breaker failures are service-
         health signals only: give-ups and shed/bad-frame refusals —
         never per-client refusals (module docstring)."""
-        if self._brk_threshold <= 0:
-            return
-        if self._brk_state == "half_open" and rid == self._brk_probe:
-            self._brk_probe = None
-            if ok:
-                self._brk_state = "closed"
-                self._brk_outcomes.clear()
-                self._brk_backoff = self._brk_backoff0
-            else:
-                self._breaker_open()
-            return
-        self._brk_outcomes.append(bool(ok))
-        if (self._brk_state == "closed"
-                and len(self._brk_outcomes) >= self._brk_threshold
-                and self._brk_outcomes.count(False)
-                >= self._brk_threshold):
-            self._breaker_open()
+        self._breaker.record(rid, ok)
 
     def submit(self, x: np.ndarray,
                deadline_s: Optional[float] = None) -> int:
@@ -296,10 +253,14 @@ class InferenceClient:
         budget = self.deadline_s if deadline_s is None else float(deadline_s)
         if budget > 0:
             msg["deadline_ms"] = budget * 1e3
-        rid = self._send(msg)
-        if self._brk_state == "half_open" and self._brk_probe is None:
-            self._brk_probe = rid
-            self._m["breaker_probes"].inc()
+        try:
+            rid = self._send(msg)
+        except Exception:
+            # no probe ever hit the wire: the admit() reservation must
+            # not stay wedged
+            self._breaker.release_probe()
+            raise
+        self._breaker.arm_probe(rid)
         return rid
 
     def _command(self, cmd: str, timeout: Optional[float] = None) -> dict:
@@ -367,7 +328,7 @@ class InferenceClient:
                     or rep.get("policy") == "failover")
                 replica = rep.get("replica_id")
                 if rep.get("lb") and isinstance(replica, str) \
-                        and rid != self._brk_probe:
+                        and rid != self._breaker.probe:
                     # balancer reply: a FAILURE belongs to the stamped
                     # replica's window, never the whole-service breaker
                     # (module docstring; the half-open probe is exempt —

@@ -191,6 +191,11 @@ class InferenceServer:
                                          d_bal["heartbeat_s"]))
         self._tracer = telemetry.tracer()
         self.started_at: Optional[float] = None
+        #: optional FaultSchedule for the router loop's built-in
+        #: ingress fault hook (ISSUE 14 cross-plane soak); the live
+        #: TransportLoop sits on ``_transport`` while serving
+        self.transport_chaos = None
+        self._transport = None
         self._outbound: "queue.Queue" = queue.Queue()
         self._wake_addr: Optional[str] = None    # set at serve() bind
         self._stop = threading.Event()
@@ -395,86 +400,74 @@ class InferenceServer:
             self._ready.set()
 
     def _serve(self) -> None:
-        import zmq
+        from znicz_tpu.transport import TransportLoop
 
-        from znicz_tpu.network_common import bind_with_retry, make_poller
-
-        ctx = zmq.Context.instance()
-        sock = ctx.socket(zmq.ROUTER)
-        sock.setsockopt(zmq.LINGER, 0)
-        bind_with_retry(sock, self.bind)
-        self.endpoint = sock.getsockopt(zmq.LAST_ENDPOINT).decode()
-        # outbound wake-up: the compute thread pokes this inproc pair
-        # when it enqueues replies, so a finished batch ships on the
-        # NEXT poll wake instead of waiting out the poll timeout (the
-        # reply tax was the whole sequential-baseline RTT otherwise)
-        self._wake_addr = f"inproc://znicz-serve-wake-{id(self)}"
-        wake_r = ctx.socket(zmq.PULL)
-        wake_r.setsockopt(zmq.LINGER, 0)
-        bind_with_retry(wake_r, self._wake_addr)
-        # fleet membership (ISSUE 12): a DEALER to the balancer, owned
-        # by THIS router thread like the codec — heartbeats ride the
-        # poll loop's cadence, acks are drained and discarded
-        hb = None
-        next_hb = 0.0
-        if self.announce:
-            hb = ctx.socket(zmq.DEALER)
-            hb.setsockopt(zmq.LINGER, 0)
-            hb.connect(self.announce)
-        if self._warmup:
-            # compile every rung BEFORE taking traffic: first-request
-            # latency must not eat a compile, and the zero-recompile
-            # gate needs its baseline
-            self.runner.warmup(self.batcher.ladder)
-        self.started_at = time.perf_counter()
-        self._compute_thread = threading.Thread(
-            target=self._compute_loop, daemon=True, name="znicz-infer")
-        self._compute_thread.start()
-        poller = make_poller(sock, wake_r) if hb is None \
-            else make_poller(sock, wake_r, hb)
-        self._ready.set()
+        loop = self._transport = TransportLoop(
+            "serving", stop=self._stop, instance=self.replica_id)
+        if self.transport_chaos is not None:
+            loop.inject_faults(self.transport_chaos)
+        sock = None
+        state = {"next_hb": 0.0}
         try:
-            while not self._stop.is_set():
+            sock = loop.bind_router(self.bind)
+            self.endpoint = loop.resolved_endpoint(sock)
+            # outbound wake-up: the compute thread pokes this inproc
+            # pair when it enqueues replies, so a finished batch ships
+            # on the NEXT poll wake instead of waiting out the poll
+            # timeout (the reply tax was the whole sequential-baseline
+            # RTT otherwise)
+            self._wake_addr = f"inproc://znicz-serve-wake-{id(self)}"
+            wake_r = loop.bind_pull(self._wake_addr)
+            # fleet membership (ISSUE 12): a DEALER to the balancer,
+            # owned by THIS router thread like the codec — heartbeats
+            # ride the tick cadence, acks are drained and discarded
+            hb = loop.connect_dealer(self.announce) if self.announce \
+                else None
+            if self._warmup:
+                # compile every rung BEFORE taking traffic: first-
+                # request latency must not eat a compile, and the
+                # zero-recompile gate needs its baseline
+                self.runner.warmup(self.batcher.ladder)
+            self.started_at = time.perf_counter()
+            self._compute_thread = threading.Thread(
+                target=self._compute_loop, daemon=True,
+                name="znicz-infer")
+            self._compute_thread.start()
+            loop.register(sock,
+                          lambda frames: self._handle(sock, frames),
+                          drain=True)
+            loop.register(wake_r, lambda _token: None, drain=True)
+            if hb is not None:
+                loop.register(hb, lambda _ack: None, drain=True)
+
+            def tick() -> None:
                 if self.max_requests is not None and \
                         self.served + self.timed_out + self.rejected \
                         >= self.max_requests:
-                    break
+                    loop.stop()
+                    return
                 if hb is not None:
                     now = time.perf_counter()
-                    if now >= next_hb:
-                        next_hb = now + self.heartbeat_s
+                    if now >= state["next_hb"]:
+                        state["next_hb"] = now + self.heartbeat_s
                         hb.send_multipart(
                             [b""] + self.codec.encode(
                                 self.heartbeat_payload()), copy=False)
                         self._m["heartbeats_out"].inc()
-                if poller.poll(5):
-                    while True:             # drain queued wake tokens
-                        try:
-                            wake_r.recv(zmq.NOBLOCK)
-                        except zmq.Again:
-                            break
-                    if hb is not None:
-                        while True:         # drain heartbeat acks
-                            try:
-                                hb.recv_multipart(zmq.NOBLOCK)
-                            except zmq.Again:
-                                break
-                    while True:             # drain every queued message
-                        try:
-                            frames = sock.recv_multipart(zmq.NOBLOCK)
-                        except zmq.Again:
-                            break
-                        self._handle(sock, frames)
                 self._drain_outbound(sock)
+
+            loop.add_tick(tick)
+            tick()                      # first heartbeat pre-poll
+            self._ready.set()
+            loop.run(poll_ms=5)
         finally:
             self._stop.set()
             self.batcher.close()
-            self._compute_thread.join(timeout=30)
-            self._drain_outbound(sock)      # flush final replies
-            sock.close(0)
-            wake_r.close(0)
-            if hb is not None:
-                hb.close(0)
+            if self._compute_thread is not None:
+                self._compute_thread.join(timeout=30)
+            if sock is not None:
+                self._drain_outbound(sock)  # flush final replies
+            loop.close()
 
     def _drain_outbound(self, sock) -> None:
         n = 0
@@ -516,7 +509,7 @@ class InferenceServer:
                              self.codec.bad_frames + 1)
             sock.send_multipart(
                 list(envelope)
-                + self.codec.refusal(f"bad frame: {exc}", legacy=False,
+                + self.codec.refusal(exc, legacy=False,
                                      replica_id=self.replica_id))
             return
         cmd = req.get("cmd")

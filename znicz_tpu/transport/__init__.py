@@ -1,0 +1,35 @@
+"""Unified async transport core (ISSUE 14, ROADMAP item 4): the ONE
+event-loop + client fault model every ZMQ plane rides — master, relays,
+serving frontend, replica balancer, chaos drivers, and both clients.
+
+  - :class:`TransportLoop` (core.py): poller-driven REP/ROUTER/DEALER
+    dispatch, bind/registration conventions, idle ticks, per-plane
+    telemetry, built-in seeded fault injection;
+  - :class:`RetryPolicy` / :class:`CircuitBreaker` (retry.py): the one
+    backoff curve + the rolling-window breaker, constants preserved
+    per plane;
+  - :class:`Endpoint` (endpoint.py): fresh-socket reconnect,
+    resend-same-bytes, breaker fail-fast and deadline budget helpers
+    for every REQ-style client link;
+  - :class:`TokenBucket` / :class:`AdmissionTable` (admission.py): the
+    per-peer admission primitive, lifted from the serving plane to
+    every ingress.
+
+znicz-lint's ``transport-core`` rule keeps new planes here: any raw
+poller dispatch loop, hand-rolled reconnect cycle, or ``2 **`` backoff
+sleep outside this package is flagged.
+"""
+
+from .admission import AdmissionTable, TokenBucket        # noqa: F401
+from .core import (TransportLoop, bad_frame_reply,        # noqa: F401
+                   corrupt_message, corrupt_payload)
+from .endpoint import (BadReply, Endpoint, PeerTimeout,   # noqa: F401
+                       TransportFault, local_deadline, remaining_ms)
+from .retry import (CircuitBreaker, CircuitOpenError,     # noqa: F401
+                    RetryPolicy)
+
+__all__ = ["AdmissionTable", "TokenBucket", "TransportLoop",
+           "bad_frame_reply", "corrupt_message", "corrupt_payload",
+           "BadReply", "Endpoint", "PeerTimeout", "TransportFault",
+           "local_deadline", "remaining_ms", "CircuitBreaker",
+           "CircuitOpenError", "RetryPolicy"]

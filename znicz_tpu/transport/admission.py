@@ -1,0 +1,117 @@
+"""Admission primitives shared by every ingress (ISSUE 14).
+
+:class:`TokenBucket` is the serving batcher's per-client rate limiter
+(PR 6), moved to the transport core so the MASTER's ingress meters
+per-slave message rates with the same primitive instead of forking it
+(ROADMAP item 4: "the admission-control policy core in serving/
+batcher.py lifts to every ingress").  ``serving/batcher.py`` re-exports
+it under its historical name.
+
+:class:`AdmissionTable` is the bounded per-peer bucket table both
+ingresses need: lazily-built buckets, lossless full-bucket sweep (a
+refilled-to-capacity bucket is indistinguishable from a fresh one, so
+dropping it loses nothing), oldest-first eviction past the hard cap.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict
+
+
+class TokenBucket:
+    """Per-client rate limiter: ``rate`` units/s refill into a bucket
+    of ``burst`` capacity; a submit takes its unit count or is refused.
+    Burst admits a cold client's first flurry; sustained traffic is
+    capped at ``rate``."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t_last = time.perf_counter()
+
+    def try_take(self, n: int) -> bool:
+        now = time.perf_counter()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def refund(self, n: int) -> None:
+        """Return ``n`` taken tokens (a later admission stage refused
+        the request): a shed must not ALSO burn the client's rate
+        budget, or a recovering client gets rate_limited refusals it
+        never earned."""
+        self.tokens = min(self.burst, self.tokens + n)
+
+    def is_full(self, now: float) -> bool:
+        """True when the bucket has refilled to capacity — state
+        identical to a freshly built bucket, so it can be dropped and
+        lazily rebuilt without the client noticing."""
+        return min(self.burst,
+                   self.tokens + (now - self.t_last) * self.rate) \
+            >= self.burst
+
+
+class AdmissionTable:
+    """Bounded ``{peer_id: TokenBucket}`` (the PR 6 table discipline,
+    one home): ``try_take`` builds buckets lazily; at the soft bound a
+    LOSSLESS sweep drops refilled-to-capacity buckets first, and past
+    the hard cap the oldest entry goes (a re-arriving peer just gets a
+    fresh full bucket — strictly more permissive, never less)."""
+
+    def __init__(self, rate: float, burst: float = 0.0,
+                 max_peers: int = 4096):
+        self.rate = float(rate)
+        #: 0 = auto: one second of sustained rate (so burst admission
+        #: and sustained metering meet at the same number)
+        self.burst = float(burst) if burst else max(self.rate, 1.0)
+        self.max_peers = int(max_peers)
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate > 0.0
+
+    def try_take(self, peer: str, n: int = 1) -> bool:
+        """True when ``peer`` may pass ``n`` units right now; always
+        True while the limiter is disabled (rate <= 0)."""
+        if not self.enabled:
+            return True
+        bucket = self._buckets.get(peer)
+        if bucket is None:
+            if len(self._buckets) >= self.max_peers:
+                now = time.perf_counter()
+                full = [p for p, b in self._buckets.items()
+                        if b.is_full(now)]
+                for p in full:
+                    del self._buckets[p]
+                while len(self._buckets) >= self.max_peers:
+                    self._buckets.popitem(last=False)
+            bucket = self._buckets[peer] = TokenBucket(self.rate,
+                                                       self.burst)
+        return bucket.try_take(n)
+
+    def refund(self, peer: str, n: int) -> None:
+        """Return ``n`` taken units (a later admission stage refused
+        the request — the serving batcher's shed-refund rule): the
+        refusal must not ALSO burn the peer's rate budget.  A no-op
+        for an unknown/swept peer (its next bucket starts full, which
+        is strictly more permissive)."""
+        bucket = self._buckets.get(peer)
+        if bucket is not None:
+            bucket.refund(n)
+
+    def snapshot(self) -> Dict[str, float]:
+        """{peer: tokens remaining} for status panels."""
+        return {p: round(b.tokens, 2) for p, b in self._buckets.items()}
+
+    def __len__(self) -> int:
+        return len(self._buckets)

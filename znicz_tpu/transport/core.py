@@ -1,0 +1,343 @@
+"""The unified async transport core (ISSUE 14, ROADMAP item 4).
+
+Every ZMQ dataplane loop in the stack — master REP, relay REP, serving
+ROUTER frontend, replica-balancer ROUTER, chaos proxy, scripted
+replica — is ONE shape: create sockets, bind with the EADDRINUSE retry,
+register them POLLIN, then loop {poll -> drain ready sockets -> idle
+ticks} until told to stop.  Before this module each plane hand-rolled
+that shape (five forks, each with its own conventions); this is the one
+home.  :class:`TransportLoop` owns the poller, the socket factories,
+the dispatch order, the idle ticks, the per-plane message/fault
+telemetry, and the built-in seeded fault-injection hook — so chaos
+coverage, accounting, and (via :mod:`.endpoint` on the client side)
+retries/backoff/breakers/deadlines come FREE on every existing and
+future plane instead of being re-forked onto it.
+
+Refusal discipline: :func:`bad_frame_reply` is the one home for the
+``bad_frame`` refusal payload every plane answers undecodable traffic
+with — the cross-plane chaos soak (tests/test_transport.py) asserts
+the slug comes from here on master, relay, frontend AND balancer.
+
+Fault injection: ``inject_faults(schedule)`` applies a
+:class:`~znicz_tpu.parallel.chaos.FaultSchedule`'s TRANSPORT stream
+(``decide_transport`` — salted, so wire/compute/preempt decisions of
+the same seed replay byte-identically) to every inbound message:
+``drop`` discards it, ``corrupt`` mutates one payload frame (never the
+routing envelope) so the plane's own refusal path fires.  On a
+lockstep REP socket a drop would wedge the state machine, so drops are
+remapped to corrupt there — counted as what was DONE.  Faults are
+counted per plane in the ``znicz_transport_faults_total`` family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def bad_frame_reply(exc) -> dict:
+    """The shared ``bad_frame`` refusal payload (one home for the slug
+    + wording every plane's clients pattern-match on)."""
+    return {"ok": False, "bad_frame": True, "error": f"bad frame: {exc}"}
+
+
+def corrupt_payload(payload: bytes) -> bytes:
+    """Deterministic frame corruption (moved here from parallel/chaos
+    so the proxy and the built-in hook share one mutation): truncate to
+    a third and flip the first byte — reliably undecodable (a torn
+    pickle, or a tensor frame whose length no longer matches its v3
+    manifest entry).  An empty frame grows a poison byte instead —
+    still a guaranteed manifest-length mismatch."""
+    if not payload:
+        return b"\xff"
+    cut = max(1, len(payload) // 3)
+    head = bytearray(payload[:cut])
+    head[0] ^= 0xFF
+    return bytes(head)
+
+
+def corrupt_message(frames: List[bytes], pick_seed) -> List[bytes]:
+    """Corrupt exactly ONE payload frame of a multipart message —
+    metadata or any tensor buffer, picked as a pure function of
+    ``pick_seed`` — and never the routing envelope (peer identity /
+    REQ correlate id / empty delimiter), so a refusal reply can still
+    be routed back."""
+    import numpy as np
+
+    from znicz_tpu.parallel.wire import split_envelope
+
+    envelope, payload = split_envelope(frames)
+    if not payload:                     # degenerate: nothing to corrupt
+        return frames
+    pick = int(np.random.default_rng(pick_seed).integers(len(payload)))
+    payload[pick] = corrupt_payload(payload[pick])
+    return envelope + payload
+
+
+class _Entry:
+    """One registered socket: its handler and dispatch discipline."""
+
+    __slots__ = ("sock", "handler", "reply", "drain", "priority", "seq")
+
+    def __init__(self, sock, handler, reply: bool, drain: bool,
+                 priority: int, seq: int):
+        self.sock = sock
+        self.handler = handler
+        self.reply = reply              # REP lockstep: send handler()'s
+        self.drain = drain              # NOBLOCK-drain all queued msgs
+        self.priority = priority
+        self.seq = seq
+
+
+class TransportLoop:
+    """Poller-driven serve loop every plane rides (module docstring).
+
+    Usage::
+
+        loop = TransportLoop("master", stop=stop_event)
+        sock = loop.bind_rep(endpoint)
+        loop.register(sock, reply_fn, reply=True)
+        loop.add_tick(idle_fn)          # reap/evict/flush/heartbeat...
+        loop.run(poll_ms=100)           # blocks until stop()/stop event
+        loop.close()                    # in the caller's finally
+
+    Handlers receive the raw multipart frame list.  ``reply=True``
+    registers REP lockstep dispatch: the handler RETURNS the reply
+    frames and the loop sends them (``copy=False``).  ``drain=True``
+    NOBLOCK-drains every queued message per wake (ROUTER/DEALER
+    convention); handlers on such sockets send their own replies.
+    ``priority`` orders dispatch within one poll wake (lower first —
+    the balancer drains replica replies before new client requests so
+    its load view is never one tick stale).  Sockets may be registered
+    and unregistered while the loop runs (the balancer's dynamic
+    replica DEALERs).
+    """
+
+    def __init__(self, plane: str,
+                 stop: Optional[threading.Event] = None,
+                 instance: str = ""):
+        from znicz_tpu import telemetry
+
+        self.plane = str(plane)
+        self._stop = stop if stop is not None else threading.Event()
+        self._entries: List[_Entry] = []
+        self._ticks: List[Callable[[], None]] = []
+        self._poller = None
+        self._ctx = None
+        self._owned: List[object] = []      # sockets this loop created
+        self._seq = 0
+        self._chaos = None
+        self._chaos_no = 0
+        # ``instance`` disambiguates SAME-plane loops in one process
+        # (two relays of a tree, several replicas): the registry is
+        # latest-instance-wins per label set, so without it one loop's
+        # exported series would shadow the other's.  Planes pass their
+        # bind/endpoint/replica id — the label churn the relay's own
+        # bind= label already set the precedent for.
+        labels = {"plane": self.plane}
+        if instance:
+            labels["instance"] = str(instance)
+        _sc = telemetry.scope("transport")
+        self._m_messages = _sc.counter(
+            "transport_messages",
+            "messages dispatched by the transport loop", **labels)
+        self._m_faults: Dict[str, object] = {
+            action: _sc.counter(
+                "transport_faults", "ingress faults injected by the "
+                "transport loop's built-in hook", action=action,
+                **labels)
+            for action in ("drop", "corrupt")}
+
+    # -- socket factories (the one home for build + bind conventions) ---------
+
+    def _context(self):
+        import zmq
+
+        if self._ctx is None:
+            self._ctx = zmq.Context.instance()
+        return self._ctx
+
+    def _bound(self, kind: int, endpoint: str):
+        import zmq
+
+        from znicz_tpu.network_common import bind_with_retry
+
+        sock = self._context().socket(kind)
+        sock.setsockopt(zmq.LINGER, 0)
+        bind_with_retry(sock, endpoint)
+        self._owned.append(sock)
+        return sock
+
+    def _connected(self, kind: int, endpoint: str):
+        import zmq
+
+        sock = self._context().socket(kind)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(endpoint)
+        self._owned.append(sock)
+        return sock
+
+    def bind_rep(self, endpoint: str):
+        import zmq
+
+        return self._bound(zmq.REP, endpoint)
+
+    def bind_router(self, endpoint: str):
+        import zmq
+
+        return self._bound(zmq.ROUTER, endpoint)
+
+    def bind_pull(self, endpoint: str):
+        import zmq
+
+        return self._bound(zmq.PULL, endpoint)
+
+    def connect_dealer(self, endpoint: str):
+        import zmq
+
+        return self._connected(zmq.DEALER, endpoint)
+
+    @staticmethod
+    def resolved_endpoint(sock) -> str:
+        """The concrete address of a (possibly wildcard) bind."""
+        import zmq
+
+        return sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+
+    # -- registration ----------------------------------------------------------
+
+    def register(self, sock, handler, *, reply: bool = False,
+                 drain: bool = False, priority: int = 100) -> None:
+        self._seq += 1
+        self._entries.append(_Entry(sock, handler, reply, drain,
+                                    priority, self._seq))
+        self._entries.sort(key=lambda e: (e.priority, e.seq))
+        if self._poller is not None:
+            import zmq
+
+            self._poller.register(sock, zmq.POLLIN)
+
+    def unregister(self, sock, close: bool = True) -> None:
+        self._entries = [e for e in self._entries if e.sock is not sock]
+        if self._poller is not None:
+            self._poller.unregister(sock)
+        if close:
+            sock.close(0)
+            if sock in self._owned:
+                self._owned.remove(sock)
+
+    def add_tick(self, fn: Callable[[], None]) -> None:
+        """Idle work run once per lap AFTER socket dispatch: reaping,
+        eviction, flushes, heartbeats, resume snapshots, stop
+        predicates (a tick may call :meth:`stop`)."""
+        self._ticks.append(fn)
+
+    # -- chaos (built in, ISSUE 14) --------------------------------------------
+
+    def inject_faults(self, schedule) -> None:
+        """Install a seeded ingress fault hook: every inbound message
+        gets one ``schedule.decide_transport(i)`` decision (module
+        docstring).  ``None`` uninstalls."""
+        self._chaos = schedule
+        self._chaos_no = 0
+
+    @property
+    def messages(self) -> int:
+        """Messages dispatched by this plane's loop (== transport-fault
+        stream indices consumed while a fault hook is installed)."""
+        return int(self._m_messages.value)
+
+    def fault_counts(self) -> Dict[str, int]:
+        """{action: count} injected by the built-in hook on THIS plane
+        — what the cross-plane soak holds the schedule replay to."""
+        return {action: int(c.value)
+                for action, c in self._m_faults.items()}
+
+    def _apply_chaos(self, frames: List[bytes],
+                     entry: _Entry) -> Optional[List[bytes]]:
+        """One ingress decision; None = message dropped."""
+        if self._chaos is None:
+            return frames
+        i = self._chaos_no
+        self._chaos_no += 1
+        action, _ = self._chaos.decide_transport(i)
+        if action == "drop" and entry.reply:
+            action = "corrupt"          # a REP drop would wedge lockstep
+        if action == "drop":
+            self._m_faults["drop"].inc()
+            return None
+        if action == "corrupt":
+            self._m_faults["corrupt"].inc()
+            return corrupt_message(frames,
+                                   (self._chaos.seed, i, 0xC0DE))
+        return frames
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop.is_set()
+
+    def run(self, poll_ms: int = 20,
+            timeout_fn: Optional[Callable[[], int]] = None) -> None:
+        """Blocks until :meth:`stop` (or the shared stop event).  One
+        lap = poll (``timeout_fn()`` ms when given, else ``poll_ms``)
+        -> dispatch ready sockets in priority order -> run ticks."""
+        import zmq
+
+        from znicz_tpu.network_common import make_poller
+
+        self._poller = make_poller(*[e.sock for e in self._entries])
+        try:
+            while not self._stop.is_set():
+                timeout = timeout_fn() if timeout_fn is not None \
+                    else poll_ms
+                events = dict(self._poller.poll(timeout))
+                if events:
+                    for entry in list(self._entries):
+                        if entry.sock not in events:
+                            continue
+                        if entry.reply:
+                            self._dispatch_rep(entry)
+                        elif entry.drain:
+                            while True:
+                                try:
+                                    frames = entry.sock.recv_multipart(
+                                        zmq.NOBLOCK)
+                                except zmq.Again:
+                                    break
+                                self._dispatch(entry, frames)
+                        else:
+                            self._dispatch(
+                                entry, entry.sock.recv_multipart())
+                for tick in self._ticks:
+                    tick()
+        finally:
+            self._poller = None
+
+    def _dispatch_rep(self, entry: _Entry) -> None:
+        """REP lockstep: recv one message, send the handler's reply.
+        The chaos hook may corrupt (never drop) it first — the plane's
+        own refusal path answers, keeping the lockstep intact."""
+        frames = entry.sock.recv_multipart()
+        self._m_messages.inc()
+        frames = self._apply_chaos(frames, entry)
+        entry.sock.send_multipart(entry.handler(frames), copy=False)
+
+    def _dispatch(self, entry: _Entry, frames: List[bytes]) -> None:
+        self._m_messages.inc()
+        frames = self._apply_chaos(frames, entry)
+        if frames is not None:
+            entry.handler(frames)
+
+    def close(self) -> None:
+        """Close every socket this loop's factories created (call from
+        the serving plane's ``finally``; idempotent)."""
+        for sock in self._owned:
+            sock.close(0)
+        self._owned = []
+        self._entries = []

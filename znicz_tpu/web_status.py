@@ -156,6 +156,9 @@ class WebStatus:
                 "bad_frames": srv.bad_frames,
                 "quarantined_updates": srv.quarantined_updates,
                 "reregistrations": srv.reregistrations,
+                # unified transport core (ISSUE 14): per-slave ingress
+                # admission — additive key, historical names unchanged
+                "rate_limited_ingress": srv.rate_limited_ingress,
                 "resumed": bool(srv.resumed),
                 "resume_saves": srv.resume_saves,
                 "job_timeout_s": round(srv.effective_job_timeout(), 3),
