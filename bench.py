@@ -64,6 +64,14 @@ pickle wire, per wire dtype (f32/bf16/int8) plus the zlib'd params
 broadcast — the wire-cost record that rides the trajectory files
 alongside MFU (ISSUE 3).
 
+``python bench.py --seq`` gates variable-length serving (ISSUE 15) in
+one JSON line: the 2-D (batch x seq) bucket ladder vs a single-max-len
+ladder on the charlm transformer under a skewed-short mixed-length
+stream — goodput in REAL tokens/s (FAILS below 2x), warmup compiles ==
+rungs x seq_rungs with zero recompiles over the stream, and a
+bit-exact masked-parity probe co-batched with varying same-rung
+neighbors.
+
 ``python bench.py --serve`` gates the dynamic-batching inference service
 (znicz_tpu/serving/, ISSUE 4) in one JSON line: interleaved sequential-
 batch-1 vs coalesced-saturation throughput (FAILS below 3x, measured
@@ -2208,6 +2216,239 @@ def shard_main() -> None:
         raise SystemExit("shard gates failed: " + "; ".join(failures))
 
 
+#: --seq protocol knobs (ISSUE 15): the variable-length serving gates.
+#: The model is the charlm transformer widened so per-token COMPUTE
+#: dominates per-request overhead (the --serve lesson: a toy-thin model
+#: measures only codec/python overhead, which no ladder can win back);
+#: the request stream is skewed SHORT (mean ~12 tokens vs a 64-token
+#: window), the regime where a single-max-len ladder burns most of its
+#: FLOPs on padding.  Gates are RELATIVE and interleaved best-of, per
+#: the standing cgroup-swing discipline.
+SEQ_MAX_BATCH = 8
+SEQ_MAX_LEN = 256
+SEQ_RUNGS = (8, 16, 32, 64, 128, 256)   # 4x6 executables to warm
+SEQ_MODEL = {"vocab": 64, "embed": 256, "heads": 4, "ffn": 1024}
+SEQ_MIXED_LENGTHS = (3, 5, 8, 12, 4, 16, 7, 9, 24, 6, 10, 32, 8, 14,
+                     5, 100, 11, 4, 20, 8)
+SEQ_WINDOW_S = 2.5          # per-service closed-loop window per round
+SEQ_ROUNDS = 5              # interleaved best-of rounds (early exit on
+#                             clearing the floor with margin): the 2-D
+#                             service runs ~4x more batches per second
+#                             than the 1-D baseline, so a cgroup-share
+#                             dip taxes it harder — both services need
+#                             a quiet-phase window before the ratio is
+#                             meaningful
+SEQ_GOODPUT_FLOOR = 2.0     # 2-D ladder vs single-max-len goodput
+SEQ_PARITY_PROBES = 12      # co-batched masked-parity submissions
+SEQ_WINDOW_INFLIGHT = 2 * SEQ_MAX_BATCH
+
+
+def _build_seq_workflow():
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+
+    prng.reset(1013)
+    root.charlm.loader.update({"n_train": 64, "n_valid": 16,
+                               "seq_len": SEQ_MAX_LEN})
+    root.charlm.model.update(dict(SEQ_MODEL))
+
+    from znicz_tpu.samples.charlm import CharLMWorkflow
+
+    wf = CharLMWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def seq_main() -> None:
+    """``--seq``: the variable-length serving gates (ISSUE 15), one JSON
+    line.  Three phases against the SAME charlm model on this host:
+
+      - goodput: the 2-D (batch x seq) ladder service vs the single-
+        max-len ladder service (every request padded to the full
+        window client-side — exactly what a fixed-shape service forces
+        a mixed-length stream to do), driven closed-loop with the SAME
+        skewed-short stream in INTERLEAVED windows, best-of per
+        service.  Goodput counts REAL tokens answered per second.
+        Gate: 2-D >= SEQ_GOODPUT_FLOOR x single-max-len;
+      - zero recompiles: the 2-D service compiles exactly its
+        rungs x seq-rungs product at warmup and NOTHING over the mixed
+        stream (trace counter + jax's own jit cache);
+      - masked 0-ULP parity: a fixed probe request co-batched with
+        every round of varying same-seq-rung neighbors (the batch's
+        rows rung pinned, so the executable is fixed) must come back
+        BIT-IDENTICAL every time — each reply a pure function of the
+        request's own rows and own unpadded length.
+
+    Gates are enforced AFTER the JSON line so a tripped gate never
+    destroys the measurement record."""
+    import time as _time
+
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.serving.batcher import BucketLadder
+
+    sys.setswitchinterval(1e-3)
+
+    wf = _build_seq_workflow()
+    vocab = SEQ_MODEL["vocab"]
+    rng = np.random.default_rng(1013)
+
+    from znicz_tpu.core.config import root
+
+    root.common.serving.seq.rungs = list(SEQ_RUNGS)
+    srv2d = InferenceServer(wf, max_batch=SEQ_MAX_BATCH,
+                            max_delay_ms=5.0,
+                            queue_bound=8 * SEQ_MAX_BATCH).start()
+    assert srv2d.batcher.ladder.seq_rungs == list(SEQ_RUNGS)
+    warm_compiles = srv2d.runner.compiles
+    n_buckets = len(srv2d.batcher.ladder.buckets())
+    # the single-max-len baseline: a plain 1-D ladder on the same
+    # model — every request must arrive at the full trained window
+    srv1d = InferenceServer(wf, max_batch=SEQ_MAX_BATCH,
+                            max_delay_ms=5.0,
+                            queue_bound=8 * SEQ_MAX_BATCH,
+                            ladder=BucketLadder(SEQ_MAX_BATCH)).start()
+    cli2d = InferenceClient(srv2d.endpoint, timeout=120,
+                            breaker_failures=0)
+    cli1d = InferenceClient(srv1d.endpoint, timeout=120,
+                            breaker_failures=0)
+
+    def req_of(length):
+        return rng.integers(1, vocab, size=(1, length)).astype(np.uint8)
+
+    def pad_full(x):
+        out = np.zeros((x.shape[0], SEQ_MAX_LEN), np.uint8)
+        out[:, :x.shape[1]] = x
+        return out
+
+    def drive(cli, duration_s, full_len):
+        """Closed loop over the mixed-length stream; returns (real
+        tokens answered, elapsed).  ``full_len``: pad every request to
+        the full window client-side (the 1-D service's contract)."""
+        tokens = 0
+        real_of = {}
+        i = 0
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < duration_s:
+            while cli.in_flight < SEQ_WINDOW_INFLIGHT:
+                length = SEQ_MIXED_LENGTHS[i % len(SEQ_MIXED_LENGTHS)]
+                i += 1
+                x = req_of(length)
+                rid = cli.submit(pad_full(x) if full_len else x)
+                real_of[rid] = length
+            for rep in cli.collect(0.002):
+                real = real_of.pop(rep["req_id"], 0)
+                if rep.get("ok"):
+                    tokens += real
+        elapsed = _time.perf_counter() - t0
+        while cli.in_flight:          # drain the tail, uncounted
+            for rep in cli.collect(0.01):
+                real_of.pop(rep["req_id"], None)
+        return tokens, elapsed
+
+    # warm both request paths
+    for _ in range(4):
+        cli2d.infer(req_of(12))
+        cli1d.infer(pad_full(req_of(12)))
+
+    goodput_2d = 0.0
+    goodput_1d = 0.0
+    for _ in range(SEQ_ROUNDS):
+        tok, el = drive(cli1d, SEQ_WINDOW_S, full_len=True)
+        goodput_1d = max(goodput_1d, tok / el)
+        tok, el = drive(cli2d, SEQ_WINDOW_S, full_len=False)
+        goodput_2d = max(goodput_2d, tok / el)
+        if goodput_2d >= 1.15 * SEQ_GOODPUT_FLOOR * goodput_1d:
+            break                     # floor cleared with margin
+
+    # zero recompiles over the whole mixed stream
+    recompiles = srv2d.runner.compiles - warm_compiles
+    jit_cache = srv2d.runner.jit_cache_size()
+
+    # masked 0-ULP parity: probe (4 rows, len 10 -> seq rung 16)
+    # co-batched with a same-rung 4-row filler each round — the batch
+    # must be the (8, 16) executable every round (the 0-ULP contract
+    # is per executable; PR 4/12).  A scheduler stall > max_delay_ms
+    # between the two submits can split them into (4, 16) batches —
+    # such a round proves nothing either way, so it is detected via
+    # the "8x8"->"8x16" bucket-hit counter and retried, bounded.
+    probe = rng.integers(1, vocab, size=(4, 10)).astype(np.uint8)
+    parity_replies = []
+    split_rounds = 0
+    j = 0
+    attempts = 0
+    while len(parity_replies) < SEQ_PARITY_PROBES \
+            and attempts < 3 * SEQ_PARITY_PROBES:
+        attempts += 1
+        hits_before = srv2d.batcher.bucket_hits.get("8x16", 0)
+        filler_len = 9 + (j % 8)              # rungs to 16, varies
+        j += 1
+        filler = rng.integers(1, vocab,
+                              size=(4, filler_len)).astype(np.uint8)
+        rid_p = cli2d.submit(probe)
+        rid_f = cli2d.submit(filler)
+        got = {}
+        while len(got) < 2:
+            for rep in cli2d.collect(0.05):
+                got[rep["req_id"]] = rep
+        assert got[rid_p].get("ok") and got[rid_f].get("ok"), got
+        if srv2d.batcher.bucket_hits.get("8x16", 0) != hits_before + 1:
+            split_rounds += 1                 # did not coalesce: retry
+            continue
+        parity_replies.append(got[rid_p]["y"])
+    parity_exact = len(parity_replies) == SEQ_PARITY_PROBES and all(
+        np.array_equal(parity_replies[0], y) for y in parity_replies[1:])
+
+    pad_ratio = srv2d.batcher.pad_ratio()
+    stats2d = srv2d.batcher.stats()
+    for c in (cli2d, cli1d):
+        c.close()
+    for s in (srv2d, srv1d):
+        s.stop()
+
+    ratio = goodput_2d / max(goodput_1d, 1e-9)
+    print(json.dumps({
+        "metric": "seq_serving_goodput_ratio",
+        "value": round(ratio, 3),
+        "unit": "2d_ladder_vs_single_max_len_real_tokens_per_s",
+        "goodput_2d_tok_s": round(goodput_2d, 1),
+        "goodput_1d_tok_s": round(goodput_1d, 1),
+        "goodput_floor": SEQ_GOODPUT_FLOOR,
+        "max_batch": SEQ_MAX_BATCH,
+        "max_len": SEQ_MAX_LEN,
+        "seq_rungs": list(SEQ_RUNGS),
+        "model": dict(SEQ_MODEL),
+        "warm_compiles": warm_compiles,
+        "buckets": n_buckets,
+        "recompiles_mixed_stream": recompiles,
+        "jit_cache_size": jit_cache,
+        "parity_masked_bit_exact": bool(parity_exact),
+        "parity_rounds": len(parity_replies),
+        "parity_split_rounds_retried": split_rounds,
+        "pad_ratio_by_bucket": pad_ratio,
+        "padded_cells": stats2d["padded_cells"],
+        "real_cells": stats2d["real_cells"],
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if ratio < SEQ_GOODPUT_FLOOR:
+        failures.append(f"mixed-length goodput ratio {ratio:.2f} below "
+                        f"the {SEQ_GOODPUT_FLOOR}x floor")
+    if warm_compiles != n_buckets:
+        failures.append(f"warmup compiled {warm_compiles} executables, "
+                        f"expected rungs x seq_rungs = {n_buckets}")
+    if recompiles:
+        failures.append(f"{recompiles} recompiles during the mixed "
+                        f"stream (must be 0)")
+    if jit_cache is not None and jit_cache != warm_compiles:
+        failures.append(f"jax jit cache {jit_cache} != warmup "
+                        f"compiles {warm_compiles}")
+    if not parity_exact:
+        failures.append("probe replies differ across co-batched "
+                        "neighbor lengths (masked 0-ULP contract)")
+    if failures:
+        raise SystemExit("seq gates failed: " + "; ".join(failures))
+
+
 #: --telemetry protocol knobs (ISSUE 5).  Same de-flake discipline as
 #: --serve / the PR-4 snapshot guard: enabled/disabled windows are
 #: INTERLEAVED (this container's cgroup CPU share swings minute to
@@ -2639,6 +2880,8 @@ if __name__ == "__main__":
         fleet_main()
     elif "--shard" in args:
         shard_main()
+    elif "--seq" in args:
+        seq_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

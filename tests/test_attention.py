@@ -100,6 +100,167 @@ def test_mha_unit_fwd_bwd():
         assert abs(num - dW[idx]) < 5e-2 * max(1.0, abs(num)), idx
     assert np.array(gd.err_input.map_read()).shape == x.shape
 
+def test_attention_causal_offsets():
+    """``attention(q_offset, k_offset)``: the global-position causal
+    masking sharded blocks rely on.  A query block computed with its
+    global offset over the full key set must equal the matching rows of
+    full causal attention, and explicit offsets must reproduce a numpy
+    oracle masking ``kpos > qpos``."""
+    rng = np.random.default_rng(41)
+    q, k, v = (rng.normal(size=(2, 8, 2, 4)).astype(np.float32)
+               for _ in range(3))
+    full = np.array(attention(q, k, v, causal=True))
+    blk = np.array(attention(q[:, 4:], k, v, causal=True, q_offset=4))
+    np.testing.assert_allclose(blk, full[:, 4:], rtol=1e-6, atol=1e-7)
+
+    # numpy oracle with explicit global positions: queries at 4..7,
+    # keys at 2..5 (k_offset=2) — key j visible iff 2+j <= 4+i
+    qb, kb, vb = q[:, 4:], k[:, 2:6], v[:, 2:6]
+    got = np.array(attention(qb, kb, vb, causal=True,
+                             q_offset=4, k_offset=2))
+    s = np.einsum("bqhd,bkhd->bhqk", qb, kb) / np.sqrt(4)
+    qpos = 4 + np.arange(4)
+    kpos = 2 + np.arange(4)
+    s = np.where(kpos[None, None, None, :] > qpos[None, None, :, None],
+                 -np.inf, s)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, vb)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_attention_k_valid_mask_length_independence():
+    """``k_valid`` (ISSUE 15): masked pad keys carry exactly zero
+    probability mass, so a row's output over its own L real keys equals
+    the unpadded computation — for non-causal attention too, where the
+    causal structure gives no free independence."""
+    rng = np.random.default_rng(43)
+    L, T = 5, 8
+    q, k, v = (rng.normal(size=(2, T, 2, 4)).astype(np.float32)
+               for _ in range(3))
+    # garbage in the padded tail must be invisible behind the mask
+    k[:, L:] = 1e3
+    v[:, L:] = -1e3
+    k_valid = np.zeros((2, T), bool)
+    k_valid[:, :L] = True
+    got = np.array(attention(q, k, v, k_valid=k_valid))
+    want = np.array(attention(q, k[:, :L], v[:, :L]))
+    np.testing.assert_allclose(got[:, :L], want[:, :L],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gd_mha_grads_match_attention_oracle_and_fd():
+    """Gradient-parity oracle for GDMultiHeadAttention (ISSUE 15
+    satellite): the unit's applied updates (lr=1, no momentum/decay)
+    must equal ``jax.grad`` of a loss built DIRECTLY on
+    ``ops.attention.attention`` for every projection, with finite
+    differences spot-checking the oracle itself."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.attention import GDMultiHeadAttention, MultiHeadAttention
+
+    rng = np.random.default_rng(45)
+    B, T, H, D, E = 2, 6, 2, 4, 8
+    x = rng.normal(size=(B, T, E)).astype(np.float32)
+    mha = MultiHeadAttention(name="mha_orc", heads=H, causal=True)
+    mha.input = Array(x)
+    mha.initialize(device=None)
+    mha.run()
+    err = rng.normal(size=(B, T, E)).astype(np.float32)
+
+    gd = GDMultiHeadAttention(name="mha_orc_gd", forward=mha,
+                              learning_rate=1.0, gradient_moment=0.0,
+                              need_err_input=True)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    w0 = {kk: np.array(a.map_read()) for kk, a in mha.proj.items()}
+    gd.run()
+    applied = {kk: w0[kk] - np.array(a.map_read())
+               for kk, a in mha.proj.items()}
+
+    def oracle(params, xx):
+        q = (xx @ params["wq"]).reshape(B, T, H, D)
+        k = (xx @ params["wk"]).reshape(B, T, H, D)
+        v = (xx @ params["wv"]).reshape(B, T, H, D)
+        o = attention(q, k, v, causal=True)
+        return o.reshape(B, T, H * D) @ params["wo"]
+
+    def loss(params):
+        return jnp.sum(jnp.asarray(err) * oracle(params, jnp.asarray(x)))
+
+    grads = jax.grad(loss)({kk: jnp.asarray(w) for kk, w in w0.items()})
+    for kk in ("wq", "wk", "wv", "wo"):
+        np.testing.assert_allclose(
+            applied[kk], np.asarray(grads[kk]), rtol=2e-4, atol=1e-6,
+            err_msg=f"GD update for {kk} != jax.grad of the "
+                    f"ops.attention oracle")
+    # finite differences validate the oracle itself (two entries per
+    # matrix class: an input proj and the output proj)
+    eps = 1e-2
+    for kk, idx in (("wq", (1, 2)), ("wo", (3, 5))):
+        wp = {m: w.copy() for m, w in w0.items()}
+        wm = {m: w.copy() for m, w in w0.items()}
+        wp[kk][idx] += eps
+        wm[kk][idx] -= eps
+        num = (loss({m: jnp.asarray(w) for m, w in wp.items()})
+               - loss({m: jnp.asarray(w) for m, w in wm.items()})) \
+            / (2 * eps)
+        num = float(num)
+        assert abs(num - applied[kk][idx]) < 5e-2 * max(1.0, abs(num)), \
+            (kk, idx, num, applied[kk][idx])
+    assert np.array(gd.err_input.map_read()).shape == x.shape
+
+
+def test_seq_parallel_knob_routes_mha_through_ring():
+    """``root.common.engine.seq_parallel`` (ISSUE 15): with the knob on,
+    MultiHeadAttention.apply runs ring attention over an ("sp",) mesh of
+    virtual devices and matches the dense path numerically; a seq length
+    the mesh cannot split falls back to the dense core; the knob off is
+    the bit-exact single-device path."""
+    from znicz_tpu.core.config import root
+
+    from znicz_tpu.attention import MultiHeadAttention
+
+    rng = np.random.default_rng(47)
+    x = rng.normal(size=(2, 32, 8)).astype(np.float32)
+
+    def build(name):
+        mha = MultiHeadAttention(name=name, heads=2, causal=True)
+        mha.input = Array(x)
+        mha.initialize(device=None)
+        return mha
+
+    base = build("mha_sp_off")
+    base.run()
+    ref = np.array(base.output.map_read())
+    try:
+        root.common.engine.seq_parallel = 8
+        sp = build("mha_sp_on")
+        assert sp._sp_mesh is not None and sp._sp_mesh.size == 8
+        for kk, a in base.proj.items():            # identical weights
+            sp.proj[kk].mem = np.array(a.map_read())
+        sp.run()
+        got = np.array(sp.output.map_read())
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+        # a length the mesh cannot split (serving's short buckets)
+        # falls back to the dense core instead of failing
+        short = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        out = np.array(sp.apply(
+            {kk: np.array(a.map_read()) for kk, a in sp.proj.items()},
+            short))
+        assert out.shape == short.shape
+        # a non-divisible TRAINED length is refused readably
+        bad = MultiHeadAttention(name="mha_sp_bad", heads=2, causal=True)
+        bad.input = Array(rng.normal(size=(2, 30, 8)
+                                     ).astype(np.float32))
+        with pytest.raises(ValueError, match="seq_parallel"):
+            bad.initialize(device=None)
+    finally:
+        root.common.engine.seq_parallel = 0
+
+
 def test_sequence_parallel_training_grads_match_and_learn():
     """Long-context training end-to-end: grads flow THROUGH ring attention
     under shard_map over an ('sp',) mesh, match the single-device

@@ -45,6 +45,11 @@ TINY = {
                  "root.video_ae.loader.n_valid=50",
                  "root.video_ae.loader.minibatch_size=50",
                  "root.video_ae.decision.max_epochs=1"],
+    "charlm": ["root.charlm.loader.n_train=96",
+               "root.charlm.loader.n_valid=32",
+               "root.charlm.loader.seq_len=16",
+               "root.charlm.loader.minibatch_size=32",
+               "root.charlm.decision.max_epochs=1"],
 }
 
 

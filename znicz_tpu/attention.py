@@ -1,11 +1,30 @@
-"""Multi-head attention units (beyond-reference capability; see
+"""Sequence-model units (beyond-reference capability; see
 ops/attention.py for why).  Follows the framework's unit contract: pure
 ``apply(params, x)``, a GD twin via vjp with the standard per-layer
 hyperparameters, registry type ``"attention"`` for StandardWorkflow.
 
 Input/output: (batch, seq, embed).  For sequence-parallel training, the
 fused path can swap the core for ``ops.attention.ring_attention`` inside a
-shard_map over the sequence axis (``sp_axis`` kwarg).
+shard_map over the sequence axis — either explicitly (``sp_axis`` kwarg,
+for callers already inside a shard_map) or via the
+``root.common.engine.seq_parallel`` knob (ISSUE 15): with ``seq_parallel
+= N > 1`` the unit builds an ``("sp",)`` mesh of N devices at initialize
+and ``apply`` shard_maps the attention core over it — ring attention
+leaves the dryrun on the EXISTING mesh plumbing, CPU-testable with
+virtual devices exactly like ``bench.py --shard`` (default 0 = off, the
+single-device path, bit-exact; BASELINE.md r20 records the TPU
+engagement protocol).
+
+The variable-length serving/training units live here too (ISSUE 15):
+
+  - :class:`CharEmbedding` — (batch, seq) integer ids -> token + position
+    embeddings; the id dtype crossing the wire/HBM is u8 (vocab <= 256),
+    decoded in-graph like every u8 dataset;
+  - :class:`SeqAll2All` family — POSITION-WISE dense layers (the
+    transformer FFN / logits head): same (out, in) weight layout and
+    activation surface as All2All, applied at every sequence position
+    instead of over the flattened sample (All2All's flatten is exactly
+    what a variable-length input cannot have).
 """
 
 from __future__ import annotations
@@ -16,17 +35,34 @@ import numpy as np
 
 from znicz_tpu.memory import Array
 from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
+from znicz_tpu.ops import activations
 from znicz_tpu.ops.attention import attention, ring_attention
+
+
+def seq_parallel_size() -> int:
+    """The ``root.common.engine.seq_parallel`` knob: sequence-parallel
+    mesh size for MultiHeadAttention (0/1 = off — the single-device
+    path).  Gated OFF by default; engage per BASELINE.md r20."""
+    from znicz_tpu.core.config import root
+
+    return int(root.common.engine.get("seq_parallel", 0))
 
 
 class MultiHeadAttention(ForwardBase):
     def __init__(self, workflow=None, name=None, heads=4, head_dim=None,
-                 causal=False, sp_axis=None, **kwargs):
+                 causal=False, sp_axis=None, residual=False, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)
         self.heads = int(heads)
         self.head_dim = head_dim           # default: embed // heads
         self.causal = bool(causal)
         self.sp_axis = sp_axis             # set inside shard_map for SP
+        #: y = x + attn(x): the transformer block's skip connection,
+        #: inside the unit so the strictly-sequential forward chain
+        #: (unit engine AND fused path) needs no graph surgery
+        self.residual = bool(residual)
+        #: ("sp",) mesh when root.common.engine.seq_parallel is on
+        #: (built at initialize; apply shard_maps the core over it)
+        self._sp_mesh = None
         self.proj = {k: Array() for k in ("wq", "wk", "wv", "wo")}
 
     def params(self) -> Dict[str, Array]:
@@ -35,6 +71,11 @@ class MultiHeadAttention(ForwardBase):
     def output_shape_for(self, in_shape):
         return tuple(in_shape)
 
+    def _core(self, q, k, v, axis_name=None):
+        if axis_name:
+            return ring_attention(q, k, v, axis_name, causal=self.causal)
+        return attention(q, k, v, causal=self.causal)
+
     def apply(self, params, x):
         b, t, e = x.shape
         h, d = self.heads, self.head_dim
@@ -42,10 +83,27 @@ class MultiHeadAttention(ForwardBase):
         k = (x @ params["wk"]).reshape(b, t, h, d)
         v = (x @ params["wv"]).reshape(b, t, h, d)
         if self.sp_axis:
-            o = ring_attention(q, k, v, self.sp_axis, causal=self.causal)
+            o = self._core(q, k, v, self.sp_axis)
+        elif self._sp_mesh is not None and t % self._sp_mesh.size == 0:
+            # the seq_parallel knob: ring attention over the ("sp",)
+            # mesh — q/k/v split along the sequence axis, k/v blocks
+            # rotate by ppermute, grads flow through the shard_map
+            # (tests/test_attention.py proves exactness + grad parity).
+            # A seq length the mesh cannot split (a short serving
+            # bucket) falls back to the dense core — same math.
+            from jax.sharding import PartitionSpec as P
+
+            from znicz_tpu.parallel.mesh import shard_map
+
+            spec = P(None, "sp")
+            o = shard_map(
+                lambda q, k, v: self._core(q, k, v, "sp"),
+                mesh=self._sp_mesh,
+                in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
         else:
-            o = attention(q, k, v, causal=self.causal)
-        return o.reshape(b, t, h * d) @ params["wo"]
+            o = self._core(q, k, v)
+        y = o.reshape(b, t, h * d) @ params["wo"]
+        return x + y if self.residual else y
 
     def initialize(self, device=None, **kwargs):
         b, t, e = self.input.shape
@@ -53,6 +111,16 @@ class MultiHeadAttention(ForwardBase):
             assert e % self.heads == 0, \
                 f"{self.name}: embed {e} not divisible by heads {self.heads}"
             self.head_dim = int(e) // self.heads
+        sp = seq_parallel_size()
+        if sp > 1 and self.sp_axis is None and self._sp_mesh is None:
+            if int(t) % sp:
+                raise ValueError(
+                    f"{self.name}: root.common.engine.seq_parallel={sp} "
+                    f"cannot split sequence length {t}; pick a seq "
+                    f"length divisible by the sp mesh size")
+            from znicz_tpu.parallel.mesh import make_mesh
+
+            self._sp_mesh = make_mesh((sp,), ("sp",))
         hd = self.heads * self.head_dim
         if self.proj["wq"].mem is None:
             for key, shape in (("wq", (int(e), hd)), ("wk", (int(e), hd)),
@@ -69,3 +137,134 @@ class MultiHeadAttention(ForwardBase):
 
 class GDMultiHeadAttention(GradientDescentBase):
     """vjp of the attention forward; per-layer lr/momentum/decay as usual."""
+
+
+class CharEmbedding(ForwardBase):
+    """Token + positional embedding: (batch, seq) integer ids ->
+    (batch, seq, embed).  Ids may arrive as floats (the u8 storage
+    decode widens in-graph like every u8 dataset) — they are cast back
+    to int32 for the table lookup, so the SAME pure function serves the
+    trainer's gathered rows and the serving plane's staged buckets.
+    Positions index from 0: a request padded on the RIGHT keeps its real
+    tokens' positions unchanged, which is what the masked-parity
+    contract needs."""
+
+    def __init__(self, workflow=None, name=None, vocab=256, embed=64,
+                 max_len=128, **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        self.vocab = int(vocab)
+        self.embed = int(embed)
+        self.max_len = int(max_len)
+        self.tables = {"embed": Array(), "pos": Array()}
+
+    def params(self) -> Dict[str, Array]:
+        return dict(self.tables)
+
+    def output_shape_for(self, in_shape):
+        return (in_shape[0], in_shape[1], self.embed)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+
+        ids = jnp.clip(x.astype(jnp.int32), 0, self.vocab - 1)
+        t = x.shape[1]
+        return jnp.take(params["embed"], ids, axis=0) \
+            + params["pos"][:t][None]
+
+    def initialize(self, device=None, **kwargs):
+        b, t = self.input.shape[:2]
+        if int(t) > self.max_len:
+            raise ValueError(
+                f"{self.name}: input seq length {t} exceeds max_len="
+                f"{self.max_len} (the positional table's size)")
+        if self.tables["embed"].mem is None:
+            for key, shape in (("embed", (self.vocab, self.embed)),
+                               ("pos", (self.max_len, self.embed))):
+                w = np.zeros(shape, np.float32)
+                self._fill(w, self.weights_filling,
+                           self.weights_stddev or 1.0 / np.sqrt(self.embed))
+                self.tables[key].mem = w
+        self.create_output()
+        for arr in self.tables.values():
+            arr.initialize(device)
+        super().initialize(device=device, **kwargs)
+
+
+class GDCharEmbedding(GradientDescentBase):
+    """vjp of the embedding lookup (scatter-add into the tables); the id
+    input is integral, so no err_input flows upstream (none exists)."""
+
+
+class SeqAll2All(ForwardBase):
+    """Position-wise dense layer: ``y = act(x @ W^T + b)`` at every
+    sequence position — (batch, seq, in) -> (batch, seq, width).  Same
+    (out, in) weight layout, activation surface and GD semantics as
+    All2All; what differs is exactly the flatten All2All performs (a
+    variable-length input must keep its seq axis)."""
+
+    ACTIVATION = staticmethod(activations.identity)
+
+    def __init__(self, workflow=None, name=None, output_sample_shape=(),
+                 **kwargs):
+        super().__init__(workflow=workflow, name=name, **kwargs)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.width = int(np.prod(tuple(output_sample_shape))) \
+            if output_sample_shape else 0
+
+    def output_shape_for(self, in_shape):
+        return (in_shape[0], in_shape[1], self.width)
+
+    @property
+    def output_samples_number(self) -> int:
+        """Per-position width (the All2All-compat name the fused
+        trainer's confusion sizing reads)."""
+        return self.width
+
+    def apply(self, params, x):
+        from znicz_tpu.ops.linear import seq_linear
+
+        return type(self).ACTIVATION(
+            seq_linear(x, params["weights"], params.get("bias"),
+                       weights_transposed=self.weights_transposed))
+
+    def initialize(self, device=None, **kwargs):
+        in_size = int(self.input.shape[-1])
+        if not self.width:
+            self.width = in_size
+        if self.weights.mem is None:
+            self.init_weights((self.width, in_size), (self.width,))
+        self.create_output()
+        super().initialize(device=device, **kwargs)
+
+
+class SeqAll2AllTanh(SeqAll2All):
+    ACTIVATION = staticmethod(activations.tanh_scaled)
+
+
+class SeqAll2AllStrictRELU(SeqAll2All):
+    ACTIVATION = staticmethod(activations.strict_relu)
+
+
+class SeqAll2AllSoftmax(SeqAll2All):
+    """Per-position softmax head (the LM's next-token distribution); the
+    paired GD twin treats err_output as the logits cotangent, and the
+    fused trainer emits LOGITS from this head exactly as it does for
+    All2AllSoftmax."""
+
+    ACTIVATION = staticmethod(activations.softmax)
+
+
+class GDSeqAll2All(GradientDescentBase):
+    """Backward for any SeqAll2All* via vjp of forward.apply."""
+
+
+class GDSeqSoftmax(GDSeqAll2All):
+    """err_output is d(CE)/d(logits): bypass the softmax in the vjp
+    (the same fused softmax+CE-backward convention as gd.GDSoftmax)."""
+
+    def backward_apply(self, params, x):
+        from znicz_tpu.ops.linear import seq_linear
+
+        return seq_linear(x, params["weights"], params.get("bias"),
+                          weights_transposed=self.forward.weights_transposed)

@@ -242,6 +242,10 @@ ENGINE_DEFAULTS = {
     "relay_child_ttl": 30.0,      # relay-tier child eviction window (a
     #                               tree wants a SHORTER leaf TTL than
     #                               the master's relay TTL: slave_ttl)
+    # sequence workloads (ISSUE 15)
+    "seq_parallel": 0,            # ring-attention sp mesh size for
+    #                               MultiHeadAttention (0/1 = off; the
+    #                               single-device path, bit-exact)
     # elastic async training (ISSUE 11)
     "min_slaves": 0,              # quorum gate; 0 = no gate
     "staleness_bound": 0,         # refuse deltas staler than this many

@@ -117,6 +117,58 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.max_err_output_sum = float(mes)
 
 
+class EvaluatorSeqSoftmax(EvaluatorSoftmax):
+    """Per-token softmax-CE over a sequence head (ISSUE 15): probs are
+    (batch, seq, vocab), labels (batch, seq) — every token of every
+    valid row is one classification.  Metrics flatten tokens into the
+    batch axis and reuse the softmax math verbatim (n_err counts WRONG
+    TOKENS, loss is the mean CE per token over valid rows), so the
+    Decision/printing machinery consumes them unchanged.  The fused
+    trainer mirrors this flatten in its own loss head
+    (``FusedTrainer.loss_and_metrics``) — the two must not drift.
+    Confusion defaults off (a vocab x vocab int32 matrix per minibatch
+    is pure reporting weight)."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs.setdefault("compute_confusion", False)
+        super().__init__(workflow=workflow, name=name, **kwargs)
+
+    @staticmethod
+    def compute_seq(probs, labels, batch_size, n_classes, with_confusion):
+        """Flatten-and-delegate: valid SAMPLES are a prefix, so their
+        tokens are a prefix of the flattened rows too — the base
+        per-class math applies verbatim with ``batch_size * t`` as the
+        valid-row count AND the mean denominator (per-token loss)."""
+        import jax.numpy as jnp
+
+        n, t = probs.shape[0], probs.shape[1]
+        err, n_err, loss, conf, max_err_sum = EvaluatorSoftmax.compute(
+            probs.reshape(n * t, probs.shape[-1]),
+            labels.reshape(n * t).astype(jnp.int32),
+            batch_size * t, n_classes, with_confusion)
+        return err.reshape(probs.shape), n_err, loss, conf, max_err_sum
+
+    def initialize(self, device=None, **kwargs):
+        if not self.n_classes:
+            self.n_classes = int(self.output.shape[-1])
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._compiled is None:
+            import jax
+            self._compiled = jax.jit(self.compute_seq,
+                                     static_argnums=(3, 4))
+        err, n_err, loss, conf, mes = self._compiled(
+            self.output.devmem, self.labels.devmem,
+            np.int32(self.batch_size), self.n_classes,
+            bool(self.compute_confusion))
+        self.err_output.devmem = err
+        self.confusion_matrix.devmem = conf
+        self.n_err = int(n_err)
+        self.loss = float(loss)
+        self.max_err_output_sum = float(mes)
+
+
 class EvaluatorMSE(EvaluatorBase):
     def __init__(self, workflow=None, name=None, **kwargs):
         super().__init__(workflow=workflow, name=name, **kwargs)

@@ -35,7 +35,7 @@ from znicz_tpu.core.config import apply_overrides, root
 from znicz_tpu.core.logger import setup_logging
 
 SAMPLES = ("mnist", "cifar", "mnist_ae", "kohonen", "alexnet", "wine",
-           "yale_faces", "kanji", "video_ae")
+           "yale_faces", "kanji", "video_ae", "charlm")
 
 
 def _load_module(spec: str, tag: str):

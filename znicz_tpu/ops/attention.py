@@ -23,9 +23,17 @@ from __future__ import annotations
 import math
 
 
-def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0):
+def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0,
+              k_valid=None):
     """Exact attention; offsets give global positions for causal masking of
-    sharded blocks."""
+    sharded blocks.
+
+    ``k_valid`` is an optional (batch, k) bool mask of which keys exist —
+    the variable-length serving plane's padding mask (ISSUE 15): padded
+    key positions score ``-inf`` so they carry exactly zero probability
+    mass, making each row's output a pure function of its OWN unpadded
+    length.  Each query row must keep at least one valid key (causal
+    rows always see themselves)."""
     import jax.numpy as jnp
 
     d = q.shape[-1]
@@ -35,6 +43,8 @@ def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0):
         kpos = k_offset + jnp.arange(k.shape[1])
         s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
                       -jnp.inf, s)
+    if k_valid is not None:
+        s = jnp.where(k_valid[:, None, None, :], s, -jnp.inf)
     p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)

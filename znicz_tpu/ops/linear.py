@@ -30,3 +30,14 @@ def linear(x, w, b=None, *, weights_transposed: bool = False,
     if b is not None:
         y = y + b
     return y
+
+
+def seq_linear(x, w, b=None, *, weights_transposed: bool = False):
+    """Position-wise dense: ``y = x @ W^T + b`` over the LAST dim only,
+    leading (batch, seq, ...) dims preserved — the variable-length
+    counterpart of :func:`linear`, whose flatten is exactly what a
+    sequence input cannot have (ISSUE 15).  The ONE home of the
+    transpose/bias convention for every seq unit and the fused
+    trainer's seq branches."""
+    y = x @ (w if weights_transposed else w.T)
+    return y if b is None else y + b

@@ -525,7 +525,10 @@ def fused_fc_epilogue(y, bias, key, ratio, train):
         da = g * ((y + b) > 0.0).astype(g.dtype)
         if use_mask:
             da = da * mask_of().astype(g.dtype)
-        return da.astype(y.dtype), jnp.sum(da, axis=0).astype(b.dtype)
+        # bias grad sums every leading axis (a seq epilogue's y is
+        # (B, T, F); for the classic (B, F) this is the same axis-0 sum)
+        return (da.astype(y.dtype),
+                jnp.sum(da, axis=tuple(range(da.ndim - 1))).astype(b.dtype))
 
     epilogue.defvjp(fwd, bwd)
     return epilogue(y, bias)
@@ -608,6 +611,23 @@ def match_fc_epilogue(forwards: Sequence, i: int) -> Optional[FusedTailSpec]:
     return FusedTailSpec("fc_epilogue", 1)
 
 
+def match_seq_epilogue(forwards: Sequence, i: int) -> Optional[FusedTailSpec]:
+    """SeqAll2AllStrictRELU(+bias) — the position-wise transformer-FFN
+    shape (ISSUE 15; span 1).  The softmax head is NOT matched here
+    (its epilogue is the loss head, like the All2All case), and no
+    dropout is absorbed (the charlm FFN carries none)."""
+    from znicz_tpu.attention import SeqAll2All, SeqAll2AllSoftmax
+    from znicz_tpu.ops import activations
+
+    f = forwards[i]
+    if not isinstance(f, SeqAll2All) or isinstance(f, SeqAll2AllSoftmax):
+        return None
+    if type(f).ACTIVATION is not activations.strict_relu \
+            or not f.include_bias:
+        return None
+    return FusedTailSpec("seq_epilogue", 1)
+
+
 def fused_tail_enabled() -> bool:
     """The ``root.common.engine.fused_tail`` gate (default OFF — engages
     per the BASELINE.md r12 protocol; bench.py ``--fused-tail``)."""
@@ -637,6 +657,8 @@ def plan_fused_tail(forwards: Sequence,
         spec = match_conv_bias_relu(forwards, i)
         if spec is None:
             spec = match_fc_epilogue(forwards, i)
+        if spec is None:
+            spec = match_seq_epilogue(forwards, i)
         if spec is not None:
             plan[i] = spec
             i += spec.span

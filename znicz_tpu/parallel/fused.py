@@ -71,6 +71,7 @@ class FusedTrainer:
 
     def __init__(self, workflow, mesh=None, remat=None):
         from znicz_tpu.all2all import All2AllSoftmax
+        from znicz_tpu.attention import SeqAll2AllSoftmax
         from znicz_tpu.dropout import DropoutForward
         from znicz_tpu.evaluator import EvaluatorSoftmax
         from znicz_tpu.pooling import StochasticPoolingBase
@@ -103,6 +104,10 @@ class FusedTrainer:
         else:
             self.compute_confusion = True
         self._softmax_cls = All2AllSoftmax
+        #: the per-position softmax head (ISSUE 15): like All2AllSoftmax,
+        #: the fused path emits its LOGITS and derives loss/cotangent in
+        #: the loss head (seq logits flatten tokens into the batch axis)
+        self._seq_softmax_cls = SeqAll2AllSoftmax
         self._dropout_cls = DropoutForward
         self._stochpool_cls = StochasticPoolingBase
         self.gd_of = {gd.forward.name: gd for gd in workflow.gds}
@@ -467,6 +472,16 @@ class FusedTrainer:
                 if tl.kind == "conv_bias_relu":
                     h = f.apply_linear(p, h)
                     h = fused_bias_relu(h, p["bias"])
+                elif tl.kind == "seq_epilogue":
+                    # position-wise FFN (ISSUE 15): the raw per-token
+                    # matmul plus the SAME fused bias+ReLU custom-vjp
+                    # epilogue fc6/fc7 ride (no dropout absorbed; the
+                    # backward recomputes the gate from (y, bias))
+                    from znicz_tpu.ops.linear import seq_linear
+
+                    y = seq_linear(h, p["weights"],
+                                   weights_transposed=f.weights_transposed)
+                    h = fused_fc_epilogue(y, p["bias"], None, 0.0, False)
                 else:                           # fc_epilogue
                     y = linear(h, p["weights"],
                                weights_transposed=f.weights_transposed)
@@ -495,6 +510,13 @@ class FusedTrainer:
                 h = linear(h, p["weights"], p.get("bias"),
                            weights_transposed=f.weights_transposed)
                 h = h.reshape((x.shape[0],) + f.output_sample_shape)
+            elif f is last and isinstance(f, self._seq_softmax_cls):
+                # per-position logits (ISSUE 15): the softmax is folded
+                # into the loss head exactly like the All2AllSoftmax path
+                from znicz_tpu.ops.linear import seq_linear
+
+                h = seq_linear(h, p["weights"], p.get("bias"),
+                               weights_transposed=f.weights_transposed)
             else:
                 h = f.apply(p, h)
             i += 1
@@ -524,6 +546,18 @@ class FusedTrainer:
         if self.loss_kind == "softmax":
             logits = out
             labels = target
+            if logits.ndim == 3:
+                # sequence head (ISSUE 15): every token of every valid
+                # row is one classification — flatten tokens into the
+                # batch axis and keep the identical per-class math
+                # (EvaluatorSeqSoftmax mirrors this; they must not
+                # drift).  denom scales to tokens so the reported loss
+                # stays a per-token mean.
+                t = logits.shape[1]
+                logits = logits.reshape(n * t, logits.shape[-1])
+                labels = labels.reshape(n * t).astype(jnp.int32)
+                valid = jnp.repeat(valid, t)
+                denom = jnp.maximum(batch_size * t, 1)
             from znicz_tpu.pallas_fused_block import (fused_softmax_xent,
                                                       fused_tail_enabled)
 

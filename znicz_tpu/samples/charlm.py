@@ -1,0 +1,224 @@
+"""charlm sample: the repo's first SEQUENCE workload end-to-end
+(ISSUE 15) — a small character language model built from the sequence
+units (CharEmbedding -> causal MultiHeadAttention with residual ->
+position-wise SeqAll2AllStrictRELU FFN -> SeqAll2AllSoftmax head),
+trained with next-char softmax-CE per token.
+
+    start -> repeater -> loader -> embed -> mha -> ffn -> head
+                ^                                          |
+                |                                     evaluator(seq)
+                +-- gd_embed <- gd_mha <- gd_ffn <- gd_head <- decision
+
+Everything rides the existing stack unchanged: the unit engine and the
+FusedTrainer both differentiate the same pure applies (the fused tail's
+seq epilogue + softmax-CE loss head engage under
+``root.common.engine.fused_tail``), snapshots flow through the
+snapshotter's standard collect/restore (so ``--serve --snapshot`` loads
+a charlm checkpoint like any other), master/slave distribution ships
+the param deltas as plain tensors over wire v3, and serving pads
+variable-length requests onto the 2-D (batch x seq) bucket ladder —
+importing this module declares its serving shape
+(``root.common.serving.seq.max_len`` defaults to the trained
+``seq_len``).
+
+Data: a deterministic, seeded synthetic corpus (no downloads): a cyclic
+alphabet walk whose STRIDE is announced by the first character of each
+line — the next char is predictable only from context several positions
+back, so the attention layer is load-bearing (an embedding+head model
+plateaus; with attention the token error collapses).  Ids reserve 0 as
+the serving PAD; real chars live in 1..vocab-1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.attention import (CharEmbedding, GDCharEmbedding,
+                                 GDMultiHeadAttention, GDSeqAll2All,
+                                 GDSeqSoftmax, MultiHeadAttention,
+                                 SeqAll2AllSoftmax, SeqAll2AllStrictRELU)
+from znicz_tpu.core.config import root
+from znicz_tpu.core.workflow import Repeater, Workflow
+from znicz_tpu.decision import DecisionGD
+from znicz_tpu.evaluator import EvaluatorSeqSoftmax
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.snapshotter import Snapshotter
+
+#: id 0 is the serving plane's padding id — never emitted by the corpus,
+#: so a padded tail is distinguishable from every real token
+PAD_ID = 0
+
+root.charlm.defaults({
+    "loader": {"minibatch_size": 32, "n_train": 384, "n_valid": 96,
+               "n_test": 0, "seq_len": 64},
+    "model": {"vocab": 32, "embed": 32, "heads": 2, "ffn": 64},
+    "learning_rate": 0.5,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0,
+    "decision": {"max_epochs": 8, "fail_iterations": 0},
+    "snapshotter": {"prefix": "charlm", "interval": 0},
+})
+
+
+def make_corpus(n_chars: int, vocab: int, seed: int = 1013) -> np.ndarray:
+    """The synthetic charlm stream as u8 ids in 1..vocab-1: lines of a
+    cyclic alphabet walk, each line's stride set by its seeded first
+    char — predicting a char needs the stride, i.e. CONTEXT, not just
+    the previous char."""
+    rng = np.random.default_rng(seed)
+    span = vocab - 1                      # usable alphabet (0 = PAD)
+    out = np.empty(n_chars + 1, np.uint8)
+    i = 0
+    while i < len(out):
+        stride = int(rng.integers(1, 4))          # 1..3
+        start = int(rng.integers(0, span))
+        line = (start + stride * np.arange(16)) % span + 1
+        take = min(len(line), len(out) - i)
+        out[i:i + take] = line[:take]
+        i += take
+    return out
+
+
+class CharLMLoader(FullBatchLoader):
+    """Sliding windows over the synthetic stream: data[i] is ids[i:i+T]
+    (u8 — the 1-byte wire/HBM form every u8 dataset keeps), labels[i]
+    the next-char ids ids[i+1:i+T+1]."""
+
+    def load_data(self):
+        cfg = root.charlm.loader
+        n_train = int(cfg.get("n_train", 384))
+        n_valid = int(cfg.get("n_valid", 96))
+        n_test = int(cfg.get("n_test", 0))
+        seq_len = int(cfg.get("seq_len", 64))
+        vocab = int(root.charlm.model.get("vocab", 32))
+        total = n_train + n_valid + n_test
+        stream = make_corpus(total + seq_len, vocab)
+        idx = np.arange(total)[:, None] + np.arange(seq_len)[None]
+        # order: [test | valid | train] to match class offsets
+        self.original_data.mem = stream[idx].astype(np.uint8)
+        self.original_labels.mem = stream[idx + 1].astype(np.uint8)
+        self.class_lengths = [n_test, n_valid, n_train]
+        super().load_data()
+
+    def create_minibatch_data(self):
+        super().create_minibatch_data()
+        # labels are per TOKEN (mb, T), not per sample (mb,)
+        self.minibatch_labels.mem = np.zeros(
+            (self.max_minibatch_size,) + tuple(self.original_labels.shape[1:]),
+            self.original_labels.mem.dtype)
+
+
+class CharLMWorkflow(Workflow):
+    def __init__(self, **kwargs):
+        super().__init__(name="CharLMWorkflow", **kwargs)
+        cfg = root.charlm
+        seq_len = int(cfg.loader.get("seq_len", 64))
+        vocab = int(cfg.model.get("vocab", 32))
+        embed = int(cfg.model.get("embed", 32))
+        heads = int(cfg.model.get("heads", 2))
+        ffn = int(cfg.model.get("ffn", 64))
+        lr = float(cfg.get("learning_rate"))
+        mom = float(cfg.get("gradient_moment"))
+        wd = float(cfg.get("weights_decay"))
+        # declare the serving plane's seq shape (frontend fallback when
+        # root.common.serving.seq.max_len is unset): variable-length
+        # requests bucket up to the trained window.  An attribute, not
+        # a global config write — a fixed-shape service built later in
+        # the same process must not inherit a seq axis.
+        self.serving_seq_len = seq_len
+
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = CharLMLoader(
+            self, name="loader",
+            minibatch_size=int(cfg.loader.get("minibatch_size")))
+        self.loader.link_from(self.repeater)
+
+        self.forwards = []
+        specs = [
+            ("embed", CharEmbedding,
+             dict(vocab=vocab, embed=embed, max_len=seq_len)),
+            ("mha", MultiHeadAttention,
+             dict(heads=heads, causal=True, residual=True)),
+            ("ffn", SeqAll2AllStrictRELU,
+             dict(output_sample_shape=(ffn,))),
+            ("head", SeqAll2AllSoftmax,
+             dict(output_sample_shape=(vocab,))),
+        ]
+        prev, prev_attr = self.loader, "minibatch_data"
+        for name, cls, kw in specs:
+            fwd = cls(self, name=name, **kw)
+            fwd.link_from(prev if not self.forwards else self.forwards[-1])
+            fwd.link_attrs(prev, ("input", prev_attr))
+            self.forwards.append(fwd)
+            prev, prev_attr = fwd, "output"
+
+        self.evaluator = EvaluatorSeqSoftmax(self, name="evaluator",
+                                             n_classes=vocab)
+        self.evaluator.link_from(self.forwards[-1])
+        self.evaluator.link_attrs(self.forwards[-1], "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("labels", "minibatch_labels"),
+                                  ("batch_size", "minibatch_size"))
+
+        self.decision = DecisionGD(
+            self, name="decision",
+            max_epochs=int(cfg.decision.get("max_epochs")),
+            fail_iterations=int(cfg.decision.get("fail_iterations")))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch", "class_ended",
+            "epoch_number", "class_lengths", "minibatch_size")
+        self.decision.link_attrs(
+            self.evaluator, ("minibatch_loss", "loss"),
+            ("minibatch_n_err", "n_err"), "confusion_matrix",
+            "max_err_output_sum")
+
+        self.snapshotter = Snapshotter(
+            self, name="snapshotter",
+            prefix=cfg.snapshotter.get("prefix"),
+            interval=int(cfg.snapshotter.get("interval", 0)))
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(self.decision, "epoch_number")
+        self.snapshotter.improved = self.decision.improved   # shared Bool
+        self.snapshotter.gate_skip = ~self.decision.epoch_ended
+
+        # backward chain, reverse order
+        gd_specs = [
+            ("gd_head", GDSeqSoftmax, 3, True),
+            ("gd_ffn", GDSeqAll2All, 2, True),
+            ("gd_mha", GDMultiHeadAttention, 1, True),
+            ("gd_embed", GDCharEmbedding, 0, False),
+        ]
+        self.gds = []
+        err_src, err_attr = self.evaluator, "err_output"
+        for name, cls, i, need_err in gd_specs:
+            gd = cls(self, name=name, forward=self.forwards[i],
+                     learning_rate=lr, gradient_moment=mom,
+                     weights_decay=wd, need_err_input=need_err)
+            gd.link_from(self.snapshotter if not self.gds else self.gds[-1])
+            gd.link_attrs(err_src, ("err_output", err_attr))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds.append(gd)
+            err_src, err_attr = gd, "err_input"
+
+        self.repeater.link_from(self.gds[-1])
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(snapshot: str = "", device=None) -> CharLMWorkflow:
+    wf = CharLMWorkflow()
+    wf.initialize(device=device)
+    if snapshot:
+        from znicz_tpu import snapshotter as snap_mod
+        snap_mod.restore(wf, Snapshotter.load(snapshot))
+    from znicz_tpu.engine import train
+    train(wf)
+    wf.print_stats()
+    return wf
+
+
+if __name__ == "__main__":
+    run()

@@ -59,10 +59,25 @@ class BucketLadder:
     rungs are SNAPPED UP to the next multiple of ``dp`` (then deduped —
     the ladder only ever gets shorter) and explicit rungs that do not
     divide are refused readably rather than discovered as an XLA
-    sharding error at the first request."""
+    sharding error at the first request.
+
+    **2-D (batch x seq) mode** (ISSUE 15): with ``max_len > 0`` the
+    ladder grows a SECOND axis of sequence rungs (powers of two up to
+    ``max_len``, or explicit ``seq_rungs``) for variable-length
+    workloads: a request is padded UP on both axes — its batch lands on
+    ``bucket_for(rows)`` and its OWN sequence length on
+    ``seq_bucket_for(len)`` — so the jit cache holds at most
+    ``len(rungs) * len(seq_rungs)`` executables (``buckets()``
+    enumerates them for warmup) and a mixed-length stream still causes
+    ZERO recompiles after warmup.  A request's seq rung depends only on
+    its OWN length, never on co-batched neighbors — that is what keeps
+    the 0-ULP batch-independence contract a per-(rows, seq)-executable
+    property under variable length.  dp snapping applies to the batch
+    axis only (devices shard rows, never tokens)."""
 
     def __init__(self, max_batch: int, rungs: Optional[Sequence[int]] = None,
-                 dp: int = 1):
+                 dp: int = 1, max_len: int = 0,
+                 seq_rungs: Optional[Sequence[int]] = None):
         self.max_batch = int(max_batch)
         self.dp = int(dp)
         if self.max_batch < 1:
@@ -98,6 +113,31 @@ class BucketLadder:
                     f"must be a multiple of dp so each device holds "
                     f"exactly rows/dp rows")
         self.rungs: List[int] = rungs
+        self.max_len = int(max_len)
+        if self.max_len < 0:
+            raise ValueError(f"max_len must be >= 0, got {max_len}")
+        if self.max_len == 0:
+            if seq_rungs:
+                raise ValueError(
+                    "seq_rungs given without max_len — set "
+                    "root.common.serving.seq.max_len to enable the "
+                    "2-D ladder")
+            self.seq_rungs: Optional[List[int]] = None
+        else:
+            if seq_rungs is None:
+                seq_rungs = []
+                s = 1
+                while s < self.max_len:
+                    seq_rungs.append(s)
+                    s *= 2
+                seq_rungs.append(self.max_len)
+            seq_rungs = sorted(set(int(s) for s in seq_rungs))
+            if not seq_rungs or seq_rungs[0] < 1 \
+                    or seq_rungs[-1] != self.max_len:
+                raise ValueError(
+                    f"seq ladder {seq_rungs} must be positive and end "
+                    f"at max_len={self.max_len}")
+            self.seq_rungs = seq_rungs
 
     def bucket_for(self, n: int) -> int:
         """Smallest rung >= n (n must be within the ladder)."""
@@ -107,10 +147,39 @@ class BucketLadder:
         raise ValueError(f"{n} rows exceed the ladder's top rung "
                          f"{self.rungs[-1]}")
 
+    def seq_bucket_for(self, n: int) -> int:
+        """Smallest SEQ rung >= n (2-D mode only) — a function of the
+        request's OWN length, so co-batched neighbors can never move a
+        request to a different executable's seq axis."""
+        if self.seq_rungs is None:
+            raise ValueError("ladder has no seq axis (max_len unset)")
+        for s in self.seq_rungs:
+            if n <= s:
+                return s
+        raise ValueError(f"sequence of {n} tokens exceeds the ladder's "
+                         f"top seq rung {self.seq_rungs[-1]}")
+
+    def buckets(self) -> List:
+        """Every executable shape the jit cache may hold: the batch
+        rungs (1-D mode), or the (rows, seq) product (2-D mode) —
+        the warmup set and the ``compiles == len(buckets())`` bound."""
+        if self.seq_rungs is None:
+            return list(self.rungs)
+        return [(r, s) for r in self.rungs for s in self.seq_rungs]
+
+    @staticmethod
+    def bucket_key(rows: int, seq: Optional[int] = None):
+        """The stats/telemetry key for one bucket: the plain rung int
+        (1-D, the historical shape) or ``"RxS"`` (2-D — a string so
+        /status.json keeps it as a JSON key verbatim)."""
+        return int(rows) if seq is None else f"{int(rows)}x{int(seq)}"
+
     def __iter__(self):
         return iter(self.rungs)
 
     def __repr__(self):
+        if self.seq_rungs is not None:
+            return f"BucketLadder({self.rungs} x seq{self.seq_rungs})"
         return f"BucketLadder({self.rungs})"
 
 
@@ -195,12 +264,21 @@ class Request:
     subqueue/bucket."""
 
     __slots__ = ("x", "n", "reply_to", "req_id", "trace_id", "client",
-                 "t_enqueued", "t_deadline")
+                 "t_enqueued", "t_deadline", "seq_len", "seq_rung")
 
     def __init__(self, x, n: int, reply_to=None, req_id=None,
-                 trace_id=None, client=None, deadline_s=None):
+                 trace_id=None, client=None, deadline_s=None,
+                 seq_len=None):
         self.x = x
         self.n = int(n)
+        #: variable-length workloads (ISSUE 15): the request's OWN
+        #: unpadded sequence length — the padding-mask information the
+        #: frontend keeps per request (pad tokens are PAD-id rows it
+        #: appends at assemble, and the reply is sliced back to this
+        #: length).  ``seq_rung`` is assigned at submit from the
+        #: ladder's seq axis; batches only ever coalesce ONE rung.
+        self.seq_len = None if seq_len is None else int(seq_len)
+        self.seq_rung = None
         self.reply_to = reply_to
         self.req_id = req_id
         #: optional cross-process correlation id carried in the wire-v3
@@ -233,6 +311,11 @@ class DynamicBatcher:
         "batched_requests": "requests inside closed batches",
         "batched_rows": "real rows inside closed batches",
         "padded_rows": "pad rows added by the ladder",
+        "real_cells": "real cells (rows x own tokens) inside closed "
+                      "batches — the pad_ratio denominator",
+        "padded_cells": "pad cells added by the (2-D) ladder: bucket "
+                        "area minus real cells — the padded-compute "
+                        "numerator",
     }
 
     #: per-client accounting table bound (plain state, not registry
@@ -280,10 +363,27 @@ class DynamicBatcher:
         _sc = telemetry.scope("batcher")
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
-        self._m_bucket_hits = {
-            r: _sc.counter("bucket_hits", "batches closed per ladder rung",
-                           bucket=str(r))
-            for r in self.ladder}
+        # per-bucket families (ISSUE 15): keys are the ladder's
+        # bucket_key form — plain rung ints in 1-D mode (the historical
+        # shape), "RxS" strings on a 2-D ladder.  padded/real cells per
+        # bucket make pad_ratio a measured, per-executable quantity.
+        self._m_bucket_hits = {}
+        self._m_real_cells = {}
+        self._m_pad_cells = {}
+        for b in self.ladder.buckets():
+            key = (self.ladder.bucket_key(b) if isinstance(b, int)
+                   else self.ladder.bucket_key(*b))
+            self._m_bucket_hits[key] = _sc.counter(
+                "bucket_hits", "batches closed per ladder bucket",
+                bucket=str(key))
+            self._m_real_cells[key] = _sc.counter(
+                "bucket_real_cells",
+                "real cells (rows x own tokens) per ladder bucket",
+                bucket=str(key))
+            self._m_pad_cells[key] = _sc.counter(
+                "bucket_padded_cells",
+                "pad cells (bucket area - real) per ladder bucket",
+                bucket=str(key))
         _sc.gauge("queue_depth", "rows queued, not yet batched",
                   fn=telemetry.weak_fn(self, lambda b: b._rows))
 
@@ -291,10 +391,23 @@ class DynamicBatcher:
     # (properties generated from COUNTERS after the class body)
 
     @property
-    def bucket_hits(self) -> Dict[int, int]:
-        """``{rung: batches closed at that rung}`` snapshot (historical
-        read shape; the counters live in the registry)."""
+    def bucket_hits(self) -> Dict:
+        """``{bucket: batches closed at that bucket}`` snapshot
+        (historical read shape; the counters live in the registry).
+        Keys are rung ints (1-D) or ``"RxS"`` strings (2-D)."""
         return {r: c.value for r, c in self._m_bucket_hits.items()}
+
+    def pad_ratio(self) -> Dict:
+        """``{bucket: padded cells / real cells}`` — the padded-compute
+        ratio per executable (ISSUE 15): how many pad cells the ladder
+        computed per real cell.  Buckets that never closed a batch are
+        omitted; 0.0 means every batch left exactly full."""
+        out = {}
+        for key, real in self._m_real_cells.items():
+            r = real.value
+            if r:
+                out[key] = round(self._m_pad_cells[key].value / r, 4)
+        return out
 
     # -- admission -------------------------------------------------------------
 
@@ -369,6 +482,18 @@ class DynamicBatcher:
                 f"request of {req.n} rows exceeds max_batch="
                 f"{self.max_batch} (split it client-side)",
                 scope="client")
+        if self.ladder.seq_rungs is not None:
+            # 2-D mode: the seq rung is a function of the request's OWN
+            # length (frontend validated 1 <= len <= max_len already;
+            # this is the defensive in-process-caller check)
+            if req.seq_len is None or req.seq_len < 1 \
+                    or req.seq_len > self.ladder.max_len:
+                self._m["oversized"].inc()
+                return Refusal(
+                    "oversized",
+                    f"sequence length {req.seq_len} outside the seq "
+                    f"ladder (1..{self.ladder.max_len})", scope="client")
+            req.seq_rung = self.ladder.seq_bucket_for(req.seq_len)
         adm = self.admission
         with self._cond:
             if self._closed:
@@ -446,33 +571,78 @@ class DynamicBatcher:
 
     # -- consumer side ---------------------------------------------------------
 
-    def _pop(self, key) -> Request:
-        """Dequeue the head of ``key``'s subqueue (cond held)."""
-        req = self._queues[key].popleft()
+    def _pop(self, key, idx: int = 0) -> Request:
+        """Dequeue entry ``idx`` of ``key``'s subqueue (cond held).
+        idx > 0 is the 2-D drain reaching past a mismatched-rung head
+        (``_match``); earlier entries keep their relative order."""
+        q = self._queues[key]
+        if idx:
+            q.rotate(-idx)
+            req = q.popleft()
+            q.rotate(idx)
+        else:
+            req = q.popleft()
         self._rows -= req.n
         if key in self._client_rows:
             self._client_rows[key] -= req.n
         return req
 
-    def _take_one(self, space: int) -> Optional[Request]:
+    @staticmethod
+    def _match(q, space: int, seq_rung) -> int:
+        """Index of the first queued request that fits ``space`` rows
+        AND the pinned seq rung, or -1.  With no pinned rung (a 1-D
+        ladder, or the FIRST take of any batch) only the HEAD is
+        considered — the historical strict-FIFO drain.  With a pinned
+        rung the scan reaches PAST mismatched-RUNG requests only
+        (head-of-line blocking would otherwise fragment a mixed-length
+        stream into 1-row batches — the dispatch-overhead regime
+        coalescing exists to avoid): the first SAME-rung request is
+        taken if it fits and otherwise ends the scan, so same-rung
+        requests always drain in arrival order (a smaller later
+        request never overtakes an older one that merely missed the
+        remaining space).  Skipped requests keep their
+        deadline/admission state untouched."""
+        for idx, req in enumerate(q):
+            if seq_rung is not None and req.seq_rung != seq_rung:
+                continue                # reach past OTHER rungs only
+            return idx if req.n <= space else -1
+        return -1
+
+    def _take_one(self, space: int,
+                  seq_rung: Optional[int] = None) -> Optional[Request]:
         """One request under deficit round robin, or None when nothing
         queued fits ``space`` rows (requests are never split; cond
         held).  A visited client banks ``quantum`` rows once per visit
         and keeps its turn while its banked deficit covers its head —
         rows-weighted fairness across clients, plain FIFO within one.
         A client whose queue empties is retired (classic DRR: an idle
-        queue banks nothing)."""
+        queue banks nothing).
+
+        ``seq_rung`` (2-D ladders, ISSUE 15) restricts the take to
+        requests whose OWN seq rung matches the batch being built —
+        coalescing by nearest seq rung without touching the
+        deadline/admission discipline: a mismatched head simply ends
+        that client's visit exactly like a head too big for the
+        remaining space (FIFO within a client is preserved)."""
+
         rr = self._rr
         if self._rows == 0 or not rr:
             return None
         if len(rr) == 1:
             # one subqueue (single client, or fairness off): plain FIFO,
             # no deficit bookkeeping on the hot path
-            q = self._queues[rr[0]]
-            if q and q[0].n <= space:
-                return self._pop(rr[0])
+            idx = self._match(self._queues[rr[0]], space, seq_rung)
+            if idx >= 0:
+                return self._pop(rr[0], idx)
             return None
-        if not any(q and q[0].n <= space for q in self._queues.values()):
+        # ONE scan per take: queues do not change under the lock until
+        # _pop, so each client's matched index stays valid through
+        # however many DRR rotations deficit banking needs (re-scanning
+        # per visit made 2-D assembly O(batch x queued) twice over)
+        matches = {key: idx for key, q in self._queues.items() if q
+                   for idx in (self._match(q, space, seq_rung),)
+                   if idx >= 0}
+        if not matches:
             return None                     # nothing fits: close batch
         cap = float(max(self._quantum, self.max_batch))
         while True:
@@ -490,12 +660,12 @@ class DynamicBatcher:
                 self._visiting = key
                 self._deficit[key] = min(
                     self._deficit.get(key, 0.0) + self._quantum, cap)
-            head = q[0]
-            if head.n <= space and self._deficit.get(key, 0.0) >= head.n:
-                self._deficit[key] -= head.n
-                return self._pop(key)
-            # head too big for the remaining space, or deficit not yet
-            # banked: this visit ends, next client's turn
+            idx = matches.get(key, -1)
+            if idx >= 0 and self._deficit.get(key, 0.0) >= q[idx].n:
+                self._deficit[key] -= q[idx].n
+                return self._pop(key, idx)
+            # nothing fits (space/rung), or deficit not yet banked:
+            # this visit ends, next client's turn
             rr.rotate(-1)
             self._visiting = _NO_VISIT
 
@@ -530,9 +700,13 @@ class DynamicBatcher:
                 return None
             batch = [first]
             rows = first.n
+            # 2-D ladders: the FIRST request pins the batch's seq rung;
+            # only same-rung requests coalesce into it (different rungs
+            # close this batch and immediately form their own)
+            seq_rung = first.seq_rung
             flush_at = time.perf_counter() + self.max_delay_s
             while rows < self.max_batch:
-                req = self._take_one(self.max_batch - rows)
+                req = self._take_one(self.max_batch - rows, seq_rung)
                 if req is not None:
                     batch.append(req)
                     rows += req.n
@@ -548,7 +722,22 @@ class DynamicBatcher:
         self._m["batched_requests"].inc(len(batch))
         self._m["batched_rows"].inc(rows)
         self._m["padded_rows"].inc(bucket - rows)
-        self._m_bucket_hits[bucket].inc()
+        # padded-compute accounting (ISSUE 15): real cells are each
+        # request's rows x its OWN length; the executable computes the
+        # full bucket area — the difference is pure padding FLOPs
+        if seq_rung is None:
+            key = self.ladder.bucket_key(bucket)
+            real = rows
+            area = bucket
+        else:
+            key = self.ladder.bucket_key(bucket, seq_rung)
+            real = sum(r.n * r.seq_len for r in batch)
+            area = bucket * seq_rung
+        self._m["real_cells"].inc(real)
+        self._m["padded_cells"].inc(area - real)
+        self._m_bucket_hits[key].inc()
+        self._m_real_cells[key].inc(real)
+        self._m_pad_cells[key].inc(area - real)
         return batch
 
     # -- stats -----------------------------------------------------------------
@@ -575,6 +764,11 @@ class DynamicBatcher:
             "batched_requests": self.batched_requests,
             "batched_rows": self.batched_rows,
             "padded_rows": self.padded_rows,
+            "real_cells": self.real_cells,
+            "padded_cells": self.padded_cells,
+            "pad_ratio": self.pad_ratio(),
+            "seq_rungs": (None if self.ladder.seq_rungs is None
+                          else list(self.ladder.seq_rungs)),
             "mean_occupancy": None if occ is None else round(occ, 4),
             "bucket_hits": dict(self.bucket_hits),
             "admission": self.admission_stats(),

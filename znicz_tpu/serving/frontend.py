@@ -61,6 +61,15 @@ from .model import ModelRunner
 #: test_no_adhoc_counters.py lints for silently-ignored config.
 DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             "request_ttl_s": 5.0, "max_requests": None, "web_port": None,
+            # variable-length workloads (ISSUE 15): with max_len > 0 the
+            # bucket ladder grows a SECOND (sequence) axis — requests of
+            # any length 1..max_len are padded up to power-of-two seq
+            # rungs (or the explicit ``rungs`` list, which must end at
+            # max_len), coalesced only with same-rung neighbors, and
+            # replies are sliced back to each request's own length.
+            # Importing a sequence sample (charlm) defaults max_len to
+            # its trained window.
+            "seq": {"max_len": 0, "rungs": None},
             # serving mesh (ISSUE 13; serving/model.py reads it through
             # a local alias): NamedSharding axis sizes — requests split
             # over ``data``, wide FC tails column-shard over ``model``.
@@ -149,12 +158,64 @@ class InferenceServer:
         # explicit ladder that cannot split is refused HERE, readably,
         # not as an XLA sharding error at the first request
         dp = self.runner.data_parallel
+        # 2-D seq ladder config (ISSUE 15; read through a local alias
+        # like the admission subtree, so the config-knob lint resolves
+        # the keys against DEFAULTS)
+        d_seq = DEFAULTS["seq"]
+        sq = root.common.serving.seq
+        # a sequence workflow DECLARES its serving window
+        # (workflow.serving_seq_len — charlm sets it to the trained
+        # seq_len); explicit root.common.serving.seq.max_len config
+        # wins, including an explicit 0 to force fixed-shape serving
+        declared = int(getattr(workflow, "serving_seq_len", 0) or 0)
+        seq_max_len = int(sq.get("max_len",
+                                 declared or d_seq["max_len"]) or 0)
+        seq_rungs = sq.get("rungs", d_seq["rungs"])
         if ladder is None:
-            ladder = BucketLadder(max_batch, dp=dp)
+            ladder = BucketLadder(max_batch, dp=dp, max_len=seq_max_len,
+                                  seq_rungs=seq_rungs)
         elif dp > 1 and ladder.dp != dp:
             # re-validate an explicit ladder against THIS runner's mesh
             # through the one home of the divisibility check/message
-            ladder = BucketLadder(ladder.max_batch, ladder.rungs, dp=dp)
+            ladder = BucketLadder(ladder.max_batch, ladder.rungs, dp=dp,
+                                  max_len=ladder.max_len,
+                                  seq_rungs=ladder.seq_rungs)
+        #: variable-length mode: requests carry (n, len, *tail) arrays,
+        #: len <= seq_max_len; the trained sample shape's axis 0 is the
+        #: max sequence length
+        self.seq_max_len = ladder.max_len or None
+        #: resolved lazily by _resolve_seq_out(): True when the model's
+        #: output carries the SEQ axis (replies sliced to each
+        #: request's own length), False for seq-reducing heads
+        self._seq_out: Optional[bool] = None
+        if self.seq_max_len is not None:
+            trained = int(self.runner.sample_shape[0]) \
+                if self.runner.sample_shape else 0
+            if trained and self.seq_max_len > trained:
+                raise ValueError(
+                    f"root.common.serving.seq.max_len={self.seq_max_len} "
+                    f"exceeds the model's trained sequence length "
+                    f"{trained} (positions past the trained window "
+                    f"have no embedding)")
+            # the masked-parity contract rides the CAUSAL mask: a real
+            # position never attends its row's padded tail.  A
+            # non-causal attention unit would hand PAD keys softmax
+            # mass and make replies a function of the co-batched rung
+            # — refuse at startup, not as silently-wrong answers
+            from znicz_tpu.attention import MultiHeadAttention
+
+            non_causal = [f.name for f in workflow.forwards
+                          if isinstance(f, MultiHeadAttention)
+                          and not f.causal]
+            if non_causal:
+                raise ValueError(
+                    f"variable-length serving needs causal attention, "
+                    f"but unit(s) {non_causal} attend bidirectionally — "
+                    f"padded tails would leak probability mass into "
+                    f"real positions.  Make the unit causal (mask pad "
+                    f"keys via ops.attention k_valid in a custom "
+                    f"apply), or serve fixed-shape "
+                    f"(root.common.serving.seq.max_len=0)")
         self.batcher = DynamicBatcher(
             max_batch=max_batch,
             max_delay_ms=float(_cfg("max_delay_ms", max_delay_ms)),
@@ -428,6 +489,10 @@ class InferenceServer:
                 # request latency must not eat a compile, and the
                 # zero-recompile gate needs its baseline
                 self.runner.warmup(self.batcher.ladder)
+            if self.seq_max_len is not None:
+                # resolve the output-shape probe now (cache hits after
+                # warmup), never on the compute thread mid-traffic
+                self._resolve_seq_out()
             self.started_at = time.perf_counter()
             self._compute_thread = threading.Thread(
                 target=self._compute_loop, daemon=True,
@@ -578,7 +643,23 @@ class InferenceServer:
             return
         if x.ndim == len(self.runner.sample_shape):
             x = x[None]                     # single sample shorthand
-        if tuple(x.shape[1:]) != self.runner.sample_shape:
+        seq_len = None
+        if self.seq_max_len is not None:
+            # variable-length mode (ISSUE 15): axis 1 is the request's
+            # OWN sequence length (any 1..max_len — over-long requests
+            # fall through to the batcher's readable oversized
+            # refusal); trailing dims must still match the model
+            if x.ndim != 1 + len(self.runner.sample_shape) or \
+                    tuple(x.shape[2:]) != self.runner.sample_shape[1:]:
+                sock.send_multipart(list(envelope) + self.codec.encode(
+                    {"ok": False, "req_id": rid,
+                     "replica_id": self.replica_id,
+                     "error": f"sequence request shape {x.shape} does "
+                              f"not match (n, len<= {self.seq_max_len}"
+                              f", *{self.runner.sample_shape[1:]})"}))
+                return
+            seq_len = int(x.shape[1])
+        elif tuple(x.shape[1:]) != self.runner.sample_shape:
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
                  "replica_id": self.replica_id,
@@ -636,7 +717,7 @@ class InferenceServer:
         reason = self.batcher.submit(
             Request(x, x.shape[0], reply_to=list(envelope), req_id=rid,
                     trace_id=req.get("trace_id"), client=client,
-                    deadline_s=deadline_s))
+                    deadline_s=deadline_s, seq_len=seq_len))
         if reason is not None:
             self._m["rejected"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
@@ -647,6 +728,35 @@ class InferenceServer:
                  "trace_id": req.get("trace_id"), "error": str(reason)}))
 
     # -- the compute loop (donated ping-pong) ----------------------------------
+
+    def _resolve_seq_out(self) -> bool:
+        """Does the model's output carry the SEQ axis?  Probed ONCE by
+        comparing output axis 1 across two different seq rungs (a class
+        axis cannot track the rung) — never per batch, where a class
+        count colliding with one rung would truncate logits.  A
+        single-rung seq ladder whose one rung equals the output width
+        cannot be disambiguated — refused readably rather than
+        guessed (slicing a class axis answers confidently wrong)."""
+        if self._seq_out is None:
+            lad = self.batcher.ladder
+            r0 = lad.rungs[0]
+            shapes = []
+            for s in lad.seq_rungs[:2]:
+                y = self.runner.infer(np.zeros(
+                    self.runner.bucket_shape((r0, s)), self.runner.dtype))
+                shapes.append(y.shape[1] if y.ndim >= 2 else None)
+            matched = [shapes[i] == lad.seq_rungs[i]
+                       for i in range(len(shapes))]
+            if len(matched) == 1 and matched[0]:
+                raise ValueError(
+                    f"cannot tell whether the model output's axis 1 "
+                    f"({shapes[0]}) is the sequence axis or a class "
+                    f"axis that happens to equal the single seq rung "
+                    f"{lad.seq_rungs[0]} — give the seq ladder a "
+                    f"second rung (root.common.serving.seq.rungs) so "
+                    f"the probe can disambiguate")
+            self._seq_out = all(matched)
+        return self._seq_out
 
     def _assemble(self, batch: List[Request]):
         """Coalesced requests -> (live requests, staged device buffer).
@@ -674,14 +784,26 @@ class InferenceServer:
             return None
         rows = sum(r.n for r in live)
         bucket = self.batcher.ladder.bucket_for(rows)
+        # 2-D mode: the batcher pinned ONE seq rung for this batch; the
+        # assemble buffer is (rows_rung, seq_rung, *tail), zero-filled —
+        # the padded tail of every row is PAD id 0, and each request's
+        # own length (its padding mask) rides the Request to reply time
+        seq = live[0].seq_rung
+        shape = ((bucket,) + self.runner.sample_shape if seq is None
+                 else (bucket, seq) + self.runner.sample_shape[1:])
         with self._tracer.span("serving", "assemble", rows=rows,
-                               bucket=bucket, requests=len(live)):
-            x = np.zeros((bucket,) + self.runner.sample_shape,
-                         self.runner.dtype)
+                               bucket=bucket, requests=len(live),
+                               seq=seq or 0):
+            x = np.zeros(shape, self.runner.dtype)
             off = 0
             for r in live:
-                x[off:off + r.n] = np.asarray(r.x, self.runner.dtype) \
-                    .reshape((r.n,) + self.runner.sample_shape)
+                if seq is None:
+                    x[off:off + r.n] = np.asarray(r.x, self.runner.dtype) \
+                        .reshape((r.n,) + self.runner.sample_shape)
+                else:
+                    x[off:off + r.n, :r.seq_len] = \
+                        np.asarray(r.x, self.runner.dtype).reshape(
+                            (r.n, r.seq_len) + self.runner.sample_shape[1:])
                 off += r.n
             staged = self.runner.stage(x)
         return live, staged
@@ -724,14 +846,25 @@ class InferenceServer:
                 off += r.n
                 continue
             # slice-copy: each reply owns its rows (the padded tail is
-            # dropped here — pad rows never leave the server).  ``gen``
-            # names the snapshot generation that answered — ONE per
-            # batch by construction (the runner reads (params, gen)
-            # atomically), the rollover proof's per-reply assertion.
+            # dropped here — pad rows never leave the server; on a seq
+            # output the padded TOKEN positions are sliced off too, back
+            # to the request's own length).  ``gen`` names the snapshot
+            # generation that answered — ONE per batch by construction
+            # (the runner reads (params, gen) atomically), the rollover
+            # proof's per-reply assertion.
+            yr = y[off:off + r.n]
+            # seq-shaped outputs only (probed once at startup — a
+            # seq-REDUCING model ships its rows whole; per-batch shape
+            # comparison would truncate logits whenever a class count
+            # collides with the pinned rung): cut the reply back to
+            # the request's own length
+            if r.seq_rung is not None and self._resolve_seq_out() \
+                    and yr.ndim >= 2:
+                yr = yr[:, :r.seq_len]
             self._outbound.put((r.reply_to, {
                 "ok": True, "req_id": r.req_id, "trace_id": r.trace_id,
                 "gen": gen, "replica_id": self.replica_id,
-                "y": np.array(y[off:off + r.n])}, r.t_enqueued))
+                "y": np.array(yr)}, r.t_enqueued))
             off += r.n
             self._m["served"].inc()
 

@@ -382,12 +382,23 @@ class ModelRunner:
         out[:n] = x
         return out
 
+    def bucket_shape(self, bucket) -> Tuple[int, ...]:
+        """The staged input shape for one ladder bucket: ``(rung,
+        *sample)`` for a plain batch rung, ``(rows, seq, *sample[1:])``
+        for a 2-D ``(rows, seq)`` bucket (ISSUE 15 — the seq axis
+        replaces the trained max length in axis 1)."""
+        if isinstance(bucket, tuple):
+            rows, seq = bucket
+            return (int(rows), int(seq)) + tuple(self.sample_shape[1:])
+        return (int(bucket),) + tuple(self.sample_shape)
+
     def warmup(self, ladder) -> int:
-        """Compile every ladder rung's executable up front; returns the
-        compile count afterwards — the zero-recompiles baseline the
-        serving gates compare against."""
-        for rung in ladder:
-            self.infer(np.zeros((rung,) + self.sample_shape, self.dtype))
+        """Compile every ladder bucket's executable up front (the full
+        rows x seq product on a 2-D ladder); returns the compile count
+        afterwards — the zero-recompiles baseline the serving gates
+        compare against (``compiles == len(ladder.buckets())``)."""
+        for bucket in ladder.buckets():
+            self.infer(np.zeros(self.bucket_shape(bucket), self.dtype))
         return self.compiles
 
     def swap(self, path: str, ladder=None) -> Dict:
@@ -419,9 +430,10 @@ class ModelRunner:
                 # rungs are jit cache hits on the sharded executables
                 params = self._place_params(
                     self._trainer.extract_params())
-                for rung in (ladder or ()):
+                buckets = ladder.buckets() if ladder is not None else ()
+                for bucket in buckets:
                     self._maybe_stall()
-                    x = np.zeros((rung,) + self.sample_shape, self.dtype)
+                    x = np.zeros(self.bucket_shape(bucket), self.dtype)
                     np.asarray(self._fwd(params, self.stage(x)))
                 # retain the losing side for a disk-free rollback(); the
                 # hwm (not generation+1) allocates the new id, so a

@@ -451,9 +451,20 @@ class WebStatus:
                         b = serving["batcher"]
                         m = serving["model"]
                         adm = b.get("admission", {})
+                        pad = b.get("pad_ratio", {})
+
+                        def _bucket_order(kv):
+                            # numeric (rows, seq) order: plain int rungs
+                            # (1-D) and "RxS" keys (2-D) both parse —
+                            # lexicographic order shuffled 16 before 2
+                            return tuple(int(p) for p in
+                                         str(kv[0]).split("x"))
+
                         brows = "".join(
-                            f"<tr><td>{r}</td><td>{n}</td></tr>"
-                            for r, n in sorted(b["bucket_hits"].items()))
+                            f"<tr><td>{r}</td><td>{n}</td>"
+                            f"<td>{pad.get(r, '-')}</td></tr>"
+                            for r, n in sorted(b["bucket_hits"].items(),
+                                               key=_bucket_order))
                         state = ("DRAINING" if serving.get("draining")
                                  else "ready" if serving.get("ready")
                                  else "warming")
@@ -492,7 +503,12 @@ class WebStatus:
                             f"{b['queue_depth']}/{b['queue_bound']} rows, "
                             f"shed {b['shed']}, max_batch "
                             f"{b['max_batch']}, max_delay "
-                            f"{b['max_delay_ms']} ms; jit compiles "
+                            f"{b['max_delay_ms']} ms, padded cells "
+                            f"{b.get('padded_cells', 0)} / real "
+                            f"{b.get('real_cells', 0)}"
+                            + (f", seq rungs {b['seq_rungs']}"
+                               if b.get('seq_rungs') else "")
+                            + f"; jit compiles "
                             f"{m['compiles']} (cache "
                             f"{m['jit_cache_size']})</p>"
                             f"<p>admission: "
@@ -506,7 +522,8 @@ class WebStatus:
                             "<th>accepted</th><th>rate_limited</th>"
                             f"<th>shed</th></tr>{crows}</table>"
                             "<table border=1><tr><th>bucket</th>"
-                            f"<th>hits</th></tr>{brows}</table>")
+                            "<th>hits</th><th>pad_ratio</th></tr>"
+                            f"{brows}</table>")
                     bal = snap.get("balancer")
                     if bal:
                         # the fleet panel (ISSUE 12): one row per
