@@ -72,6 +72,19 @@ rungs x seq_rungs with zero recompiles over the stream, and a
 bit-exact masked-parity probe co-batched with varying same-rung
 neighbors.
 
+``python bench.py --generate`` gates autoregressive generation serving
+(ISSUE 16) in one JSON line: the prefill/decode KV-cache path with
+continuous batching vs a naive re-prefill-per-token oracle driven over
+the SAME server's scoring plane (FAILS below 10x tokens/s, with the
+generation path's p99 inter-token latency no worse than the oracle's
+per-token p99), a per-decoded-token bit-exactness probe (the probe's
+logits streamed back BIT-IDENTICAL across co-batched rounds of varying
+neighbor content, its tokens identical down to the solo run — each
+token a pure function of its own prompt), and the zero-recompile proof
+over the mixed prompt-length/generation-length stream (warmup compiles
+== scoring buckets + the prefill/decode/migrate executable families,
+nothing after).
+
 ``python bench.py --serve`` gates the dynamic-batching inference service
 (znicz_tpu/serving/, ISSUE 4) in one JSON line: interleaved sequential-
 batch-1 vs coalesced-saturation throughput (FAILS below 3x, measured
@@ -2449,6 +2462,288 @@ def seq_main() -> None:
         raise SystemExit("seq gates failed: " + "; ".join(failures))
 
 
+#: --generate protocol knobs (ISSUE 16): the generation-serving gates.
+#: Same model-sizing lesson as --seq (compute must dominate per-token
+#: overhead or the bench measures python, not the KV cache); the
+#: trained window is 64 so oracle prefixes stay inside the scoring
+#: ladder.  Gates are RELATIVE and interleaved best-of, per the
+#: standing cgroup-swing discipline.
+GEN_MAX_BATCH = 8
+GEN_TRAIN_LEN = 64
+GEN_SEQ_RUNGS = (8, 16, 64)      # prompt ladder == scoring seq ladder
+GEN_CACHE_RUNGS = (32, 64)       # KV-cache length ladder
+GEN_SLOTS = 32                   # KV slots per cache rung
+GEN_PROMPTS = (3, 5, 8, 12, 4, 14, 7, 9, 6, 10)      # mixed lengths
+GEN_MAX_NEW = (24, 40, 32, 48, 28, 36, 40, 44, 48, 32)  # mixed budgets
+GEN_INFLIGHT = 24                # concurrent generations offered
+ORACLE_INFLIGHT = 4              # concurrent oracle token loops
+GEN_WINDOW_S = 2.5               # per-path closed-loop window per round
+GEN_ROUNDS = 4                   # interleaved best-of rounds
+GEN_TPS_FLOOR = 10.0             # generation vs re-prefill oracle
+GEN_PARITY_ROUNDS = 4            # co-batched bit-exactness rounds
+GEN_PROBE_LEN = 6
+GEN_PROBE_NEW = 40               # fill crosses the 32->64 rung mid-run
+
+
+def generate_main() -> None:
+    """``--generate``: the generation-serving gates (ISSUE 16), one
+    JSON line.  Three phases against ONE server (generation enabled on
+    the charlm transformer of --seq sizing):
+
+      - tokens/s: closed-loop ``generate`` traffic (mixed prompt
+        lengths x mixed max_new budgets) vs the naive re-prefill
+        oracle — a client loop that emits each token by scoring its
+        sequence's WHOLE prefix through the same server's classic
+        plane and sampling client-side, i.e. exactly what a
+        scoring-only service forces generation to do.  Interleaved
+        best-of windows; gate: generation >= GEN_TPS_FLOOR x oracle,
+        with generation's p99 inter-token gap (the scheduler's
+        per-sequence emission histogram) no worse than the oracle's
+        client-stamped per-token p99;
+      - per-decoded-token bit-exactness: a greedy probe generation
+        co-batched with rounds of same-shape neighbors whose CONTENT
+        (and sampled continuations) vary — the probe's per-token
+        logits must come back BIT-IDENTICAL every round (executables
+        pinned by same-shape neighbors; each row's decode reads only
+        its own KV page), and its token stream must match the solo
+        run exactly (crossing a cache-rung migration mid-generation);
+      - zero recompiles: warmup compiles == scoring buckets + the
+        prefill x prompt-rung, decode x cache-rung and migrate
+        families, and NOTHING recompiles over the whole mixed stream.
+
+    Gates are enforced AFTER the JSON line so a tripped gate never
+    destroys the measurement record."""
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    sys.setswitchinterval(1e-3)
+
+    prng.reset(1013)
+    root.charlm.loader.update({"n_train": 64, "n_valid": 16,
+                               "seq_len": GEN_TRAIN_LEN})
+    root.charlm.model.update(dict(SEQ_MODEL))
+
+    from znicz_tpu.samples.charlm import CharLMWorkflow
+
+    wf = CharLMWorkflow()
+    wf.initialize(device=None)
+    vocab = SEQ_MODEL["vocab"]
+    rng = np.random.default_rng(1013)
+
+    root.common.serving.seq.rungs = list(GEN_SEQ_RUNGS)
+    root.common.serving.generate.update({
+        "enabled": True, "cache_rungs": list(GEN_CACHE_RUNGS),
+        "slots": GEN_SLOTS})
+    srv = InferenceServer(wf, max_batch=GEN_MAX_BATCH, max_delay_ms=5.0,
+                          queue_bound=8 * GEN_MAX_BATCH).start()
+    warm_compiles = srv.runner.compiles
+    n_buckets = len(srv.batcher.ladder.buckets())
+    gen_execs = srv.gen_sched.gen.executables()
+    cli = InferenceClient(srv.endpoint, timeout=120, breaker_failures=0)
+
+    def prompt_of(length):
+        return rng.integers(1, vocab, size=length).astype(np.uint8)
+
+    # warm both request paths (compiles all counted in warm_compiles'
+    # baseline? no — warmup() already compiled every executable; these
+    # drive the warmed shapes only)
+    cli.infer(prompt_of(12)[None])
+    cli.generate(prompt_of(5), max_new_tokens=4)
+
+    def drive_generate(duration_s):
+        """Closed-loop generation window: keep GEN_INFLIGHT generations
+        going; returns (tokens emitted by finals landing inside the
+        window, elapsed).  Inter-token cadence comes from the
+        scheduler's own per-sequence emission histogram, so the
+        throughput path ships no per-token partials."""
+        toks = 0
+        i = 0
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < duration_s:
+            # hysteresis refill: submit in BURSTS so the scheduler's
+            # prefill coalescing sees real batches, not singletons
+            if cli.in_flight <= GEN_INFLIGHT - 4:
+                while cli.in_flight < GEN_INFLIGHT:
+                    plen = GEN_PROMPTS[i % len(GEN_PROMPTS)]
+                    mnew = GEN_MAX_NEW[i % len(GEN_MAX_NEW)]
+                    i += 1
+                    cli.submit_generate(prompt_of(plen), mnew)
+            for rep in cli.collect(0.002):
+                if rep.get("ok"):
+                    toks += len(rep["tokens"])
+        elapsed = _time.perf_counter() - t0
+        while cli.in_flight:            # drain the tail, uncounted
+            cli.collect(0.01)
+        return toks, elapsed
+
+    def drive_oracle(duration_s):
+        """The naive re-prefill oracle: ORACLE_INFLIGHT client-side
+        token loops, each emitting its next token by scoring its whole
+        prefix through the classic plane and argmaxing the last real
+        position — O(prefix) recompute per emitted token."""
+        toks = 0
+        gaps = []
+        i = 0
+
+        def new_seq():
+            nonlocal i
+            plen = GEN_PROMPTS[i % len(GEN_PROMPTS)]
+            mnew = GEN_MAX_NEW[i % len(GEN_MAX_NEW)]
+            i += 1
+            return {"prefix": list(prompt_of(plen)), "left": mnew,
+                    "t_last": None}
+        live = {}                       # rid -> seq state
+        idle = [new_seq() for _ in range(ORACLE_INFLIGHT)]
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < duration_s:
+            while idle:
+                s = idle.pop()
+                x = np.asarray(s["prefix"], np.uint8)[None]
+                live[cli.submit(x)] = s
+            for rep in cli.collect(0.002):
+                s = live.pop(rep["req_id"], None)
+                if s is None or not rep.get("ok"):
+                    continue
+                row = rep["y"][0, len(s["prefix"]) - 1]
+                s["prefix"].append(int(np.argmax(row)))
+                s["left"] -= 1
+                now = _time.perf_counter()
+                if s["t_last"] is not None:
+                    gaps.append(now - s["t_last"])
+                s["t_last"] = now
+                toks += 1
+                idle.append(new_seq() if s["left"] <= 0 else s)
+        elapsed = _time.perf_counter() - t0
+        while cli.in_flight:            # drain the tail, uncounted
+            for rep in cli.collect(0.01):
+                live.pop(rep["req_id"], None)
+        return toks, elapsed, gaps
+
+    gen_tps = oracle_tps = 0.0
+    oracle_gaps = []
+    for _ in range(GEN_ROUNDS):
+        tok, el, gaps = drive_oracle(GEN_WINDOW_S)
+        oracle_tps = max(oracle_tps, tok / el)
+        oracle_gaps.extend(gaps)
+        tok, el = drive_generate(GEN_WINDOW_S)
+        gen_tps = max(gen_tps, tok / el)
+        if gen_tps >= 1.15 * GEN_TPS_FLOOR * oracle_tps:
+            break                       # floor cleared with margin
+
+    gen_p99_ms = srv.gen_sched.inter_token_quantiles().get(
+        "inter_token_p99_ms")
+    oracle_p99_ms = round(float(np.percentile(oracle_gaps, 99)) * 1e3,
+                          3) if oracle_gaps else None
+
+    # per-decoded-token bit-exactness: solo reference, then co-batched
+    # rounds — neighbor SHAPES fixed (lengths 5/7/8, same max_new, so
+    # every tick's decode/prefill executable is pinned across rounds),
+    # neighbor CONTENT and sampled continuations vary per round
+    probe = prompt_of(GEN_PROBE_LEN)
+    solo = cli.generate(probe, GEN_PROBE_NEW, return_logits=True)
+    probe_logits = []
+    probe_tokens = [solo["tokens"]]
+    split_rounds = 0
+    attempts = 0
+    while len(probe_logits) < GEN_PARITY_ROUNDS \
+            and attempts < 3 * GEN_PARITY_ROUNDS:
+        attempts += 1
+        pb = srv.gen_sched.prefill_batches
+        rid_p = cli.submit_generate(probe, GEN_PROBE_NEW,
+                                    return_logits=True)
+        rids_n = [cli.submit_generate(prompt_of(n_len), GEN_PROBE_NEW,
+                                      temperature=0.9,
+                                      seed=1000 * attempts + k)
+                  for k, n_len in enumerate((5, 7, 8))]
+        reps = {}
+        while any(r not in reps for r in [rid_p] + rids_n):
+            for rep in cli.collect(0.02):
+                reps[rep["req_id"]] = rep
+        assert reps[rid_p].get("ok"), reps[rid_p]
+        if srv.gen_sched.prefill_batches != pb + 1:
+            split_rounds += 1           # did not co-batch: proves
+            continue                    # nothing either way — retry
+        probe_logits.append(reps[rid_p]["logits"])
+        probe_tokens.append(reps[rid_p]["tokens"])
+    parity_bit_exact = len(probe_logits) == GEN_PARITY_ROUNDS and all(
+        np.array_equal(probe_logits[0], lg) for lg in probe_logits[1:])
+    tokens_pure = all(np.array_equal(probe_tokens[0], t)
+                      for t in probe_tokens[1:])
+
+    # zero recompiles over everything that just ran
+    recompiles = srv.runner.compiles - warm_compiles
+    jit_cache = srv.runner.jit_cache_size()
+    gen_jit_cache = srv.gen_sched.gen.jit_cache_size()
+    gstats = srv.gen_sched.stats()
+    cli.close()
+    srv.stop()
+
+    ratio = gen_tps / max(oracle_tps, 1e-9)
+    print(json.dumps({
+        "metric": "generate_serving_tokens_per_s_ratio",
+        "value": round(ratio, 3),
+        "unit": "kv_decode_vs_reprefill_oracle_tokens_per_s",
+        "generate_tok_s": round(gen_tps, 1),
+        "oracle_tok_s": round(oracle_tps, 1),
+        "tps_floor": GEN_TPS_FLOOR,
+        "inter_token_p99_ms": gen_p99_ms,
+        "oracle_token_p99_ms": oracle_p99_ms,
+        "model": dict(SEQ_MODEL),
+        "train_len": GEN_TRAIN_LEN,
+        "cache_rungs": list(GEN_CACHE_RUNGS),
+        "prompt_rungs": list(GEN_SEQ_RUNGS),
+        "slots": GEN_SLOTS,
+        "warm_compiles": warm_compiles,
+        "scoring_buckets": n_buckets,
+        "generation_executables": gen_execs,
+        "recompiles_mixed_stream": recompiles,
+        "jit_cache_size": jit_cache,
+        "gen_jit_cache_size": gen_jit_cache,
+        "parity_logits_bit_exact": bool(parity_bit_exact),
+        "parity_tokens_pure": bool(tokens_pure),
+        "parity_rounds": len(probe_logits),
+        "parity_split_rounds_retried": split_rounds,
+        "migrations": gstats["migrations"],
+        "prefill_batches": gstats["prefill_batches"],
+        "decode_batches": gstats["decode_batches"],
+        "generated_tokens": gstats["generated_tokens"],
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if ratio < GEN_TPS_FLOOR:
+        failures.append(f"generation tokens/s only {ratio:.2f}x the "
+                        f"re-prefill oracle (floor {GEN_TPS_FLOOR}x)")
+    if gen_p99_ms is not None and oracle_p99_ms is not None \
+            and gen_p99_ms > oracle_p99_ms:
+        failures.append(f"inter-token p99 {gen_p99_ms}ms worse than "
+                        f"the oracle's per-token p99 {oracle_p99_ms}ms")
+    if warm_compiles != n_buckets + gen_execs:
+        failures.append(f"warmup compiled {warm_compiles}, expected "
+                        f"scoring buckets {n_buckets} + generation "
+                        f"executables {gen_execs}")
+    if recompiles:
+        failures.append(f"{recompiles} recompiles during the mixed "
+                        f"stream (must be 0)")
+    if jit_cache is not None and jit_cache != n_buckets:
+        failures.append(f"scoring jit cache {jit_cache} != "
+                        f"{n_buckets} buckets")
+    if gen_jit_cache is not None and gen_jit_cache != gen_execs:
+        failures.append(f"generation jit cache {gen_jit_cache} != "
+                        f"{gen_execs} executables")
+    if not parity_bit_exact:
+        failures.append("probe logits differ across co-batched "
+                        "neighbor-content rounds (bit-exactness "
+                        "contract)")
+    if not tokens_pure:
+        failures.append("probe token stream depends on co-batched "
+                        "neighbors (purity contract)")
+    if failures:
+        raise SystemExit("generate gates failed: " + "; ".join(failures))
+
+
 #: --telemetry protocol knobs (ISSUE 5).  Same de-flake discipline as
 #: --serve / the PR-4 snapshot guard: enabled/disabled windows are
 #: INTERLEAVED (this container's cgroup CPU share swings minute to
@@ -2882,6 +3177,8 @@ if __name__ == "__main__":
         shard_main()
     elif "--seq" in args:
         seq_main()
+    elif "--generate" in args:
+        generate_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

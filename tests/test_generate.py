@@ -1,0 +1,448 @@
+"""Autoregressive generation serving (ISSUE 16): the decode-attention
+NaN guard, KV-pool slot/migration accounting, decode-vs-full-forward
+parity ACROSS a cache-rung migration, the continuous-batching
+scheduler (mid-batch release, determinism, resend dedup), the e2e
+``generate`` service (streaming, refusals, neighbor invisibility,
+repeat-stream jit-cache hygiene), the web panel generation row, and a
+chaos soak (slow)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core.config import root
+
+VOCAB = 32
+
+
+def _charlm_wf(seq_len=32):
+    from znicz_tpu.core import prng
+    from znicz_tpu.samples.charlm import CharLMWorkflow
+
+    prng.reset(1013)
+    root.charlm.loader.update({"n_train": 64, "n_valid": 16, "n_test": 0,
+                               "seq_len": seq_len, "minibatch_size": 16})
+    root.charlm.model.update({"vocab": VOCAB, "embed": 32, "heads": 2,
+                              "ffn": 64})
+    wf = CharLMWorkflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
+                prompt_rungs=(8,)):
+    from znicz_tpu.serving.model import ModelRunner
+
+    runner = ModelRunner(wf)
+    return runner.enable_generation(cache_rungs=list(cache_rungs),
+                                    slots=slots,
+                                    prompt_rungs=list(prompt_rungs))
+
+
+@pytest.fixture()
+def _generate_config():
+    """Enable the generation plane for a server test, restore after."""
+    root.common.serving.seq.rungs = [8, 32]
+    root.common.serving.generate.update({
+        "enabled": True, "cache_rungs": [8, 16, 32], "slots": 4})
+    yield
+    root.common.serving.generate.enabled = False
+    root.common.serving.seq.rungs = None
+
+
+# -- decode attention op ------------------------------------------------------
+
+
+def test_attention_all_masked_keys_returns_zeros_not_nan():
+    """A query row whose keys are ALL invalid (the empty-cache decode
+    edge) must return zeros, not NaN — ``exp(-inf - -inf)`` would
+    poison the softmax without the finite fill + explicit zero +
+    denominator clamp."""
+    from znicz_tpu.ops.attention import attention
+
+    rng = np.random.default_rng(7)
+    q = rng.normal(size=(2, 1, 2, 4)).astype(np.float32)
+    k = rng.normal(size=(2, 6, 2, 4)).astype(np.float32)
+    v = rng.normal(size=(2, 6, 2, 4)).astype(np.float32)
+    out = np.asarray(attention(q, k, v,
+                               k_valid=np.zeros((2, 6), bool)))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
+def test_attention_guard_bit_identical_with_valid_keys():
+    """Rows with >= 1 valid key are BIT-identical to the unguarded
+    softmax over just the valid prefix: masked probabilities are exact
+    zeros, and adding exact zeros never perturbs a float sum."""
+    from znicz_tpu.ops.attention import attention
+
+    rng = np.random.default_rng(11)
+    q = rng.normal(size=(1, 1, 2, 4)).astype(np.float32)
+    k = rng.normal(size=(1, 6, 2, 4)).astype(np.float32)
+    v = rng.normal(size=(1, 6, 2, 4)).astype(np.float32)
+    for n_valid in (1, 3, 6):
+        k_valid = np.zeros((1, 6), bool)
+        k_valid[:, :n_valid] = True
+        guarded = np.asarray(attention(q, k, v, k_valid=k_valid))
+        plain = np.asarray(attention(q, k[:, :n_valid], v[:, :n_valid]))
+        np.testing.assert_array_equal(guarded, plain)
+
+
+def test_decode_attention_matches_causal_row():
+    """``cache_append`` + ``decode_attention`` at fill ``t`` equals row
+    ``t`` of the full causal forward: the unwritten cache tail carries
+    exactly zero probability mass.  Different executables (q len 1 vs
+    S, cache len C vs S) reduce in different orders, so the repo's
+    per-executable 0-ULP rule makes this a ~1-ULP band, not bytes."""
+    from znicz_tpu.ops.attention import (attention, cache_append,
+                                         decode_attention)
+
+    rng = np.random.default_rng(13)
+    S, C = 5, 8
+    q = rng.normal(size=(1, S, 2, 4)).astype(np.float32)
+    k = rng.normal(size=(1, S, 2, 4)).astype(np.float32)
+    v = rng.normal(size=(1, S, 2, 4)).astype(np.float32)
+    import jax.numpy as jnp
+
+    full = np.asarray(attention(q, k, v, causal=True))
+    kc = jnp.zeros((1, C, 2, 4), jnp.float32)
+    vc = jnp.zeros((1, C, 2, 4), jnp.float32)
+    for t in range(S):
+        tt = np.asarray([t], np.int32)
+        kc = cache_append(kc, k[:, t], tt)
+        vc = cache_append(vc, v[:, t], tt)
+        step = np.asarray(decode_attention(q[:, t:t + 1], kc, vc, tt))
+        np.testing.assert_allclose(step[:, 0], full[:, t],
+                                   rtol=1e-6, atol=1e-6)
+
+
+# -- KV pool bookkeeping ------------------------------------------------------
+
+
+def test_kv_pool_slot_accounting():
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16), slots=2)
+    # rung resolution
+    assert g._rung_for(5) == 8
+    assert g._rung_for(9) == 16
+    assert g._rung_for(17) is None
+    # alloc to exhaustion, release recycles; scratch is never handed out
+    a, b = g.alloc(8), g.alloc(8)
+    assert {a, b} == {0, 1} and g.scratch not in (a, b)
+    assert g.alloc(8) is None                 # rung exhausted, not scratch
+    assert g.slots_active() == 2
+    assert g.occupancy() == pytest.approx(0.5)
+    g.release(8, a)
+    assert g.alloc(8) == a
+    for s in (a, b):
+        g.release(8, s)
+    assert g.slots_active() == 0
+    st = g.stats()
+    assert st["slots_total"] == 4
+    assert st["executables"] == (len(g.prefill_rungs) * 1
+                                 + len(g.decode_rungs) * 2 + 1)
+
+
+def test_decode_parity_across_cache_rung_migration():
+    """Greedy decode through the KV pool — prefill, per-token decode,
+    and TWO rung migrations (8 -> 16 -> 32) — matches the classic
+    full-forward teacher-forced on the same growing prefix at every
+    step.  Different executables, so a numerical band, not bytes."""
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2)
+    runner = g.runner
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
+    rung = g._rung_for(len(prompt))
+    slot = g.alloc(rung)
+    x = np.zeros((1, 8), runner.dtype)
+    x[0, :5] = prompt
+    logits, _ = g.prefill(x, [5], rung, [slot])
+    toks = [int(np.argmax(logits[0]))]
+    steps = [logits[0]]
+    t = len(prompt)
+    migrations = 0
+    for _ in range(20):
+        if t >= rung:                         # fill outgrew the rung
+            dst = g._rung_for(t + 1)
+            ds = g.alloc(dst)
+            g.migrate(rung, slot, dst, ds)
+            g.release(rung, slot)
+            rung, slot = dst, ds
+            migrations += 1
+        logits, _ = g.decode(rung, [slot], [toks[-1]], [t])
+        toks.append(int(np.argmax(logits[0])))
+        steps.append(logits[0])
+        t += 1
+    assert migrations == 2                    # crossed 8->16 and 16->32
+    # classic plane: teacher-force the same prefix, read each position
+    prefix = list(prompt) + toks[:-1]
+    xb = np.zeros((1, 32), runner.dtype)
+    xb[0, :len(prefix)] = prefix
+    full = runner.infer(xb)[0]
+    for i, row in enumerate(steps):
+        np.testing.assert_allclose(row, full[len(prompt) - 1 + i],
+                                   rtol=1e-5, atol=1e-6)
+    g.release(rung, slot)
+    assert g.slots_active() == 0
+
+
+# -- continuous batching scheduler --------------------------------------------
+
+
+def _run_to_completion(sched, max_rounds=400):
+    replies = []
+    for _ in range(max_rounds):
+        if not sched.work_available():
+            break
+        _, reps = sched.step()
+        replies.extend(reps)
+    return replies
+
+
+def test_scheduler_continuous_batching():
+    """Mixed generations through the scheduler alone: co-batched decode
+    ticks, mid-batch slot release, rung migration, ladder-top
+    truncation, resend dedup, and seeded determinism on a re-run."""
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=4)
+    sched = GenerationScheduler(g, max_new_cap=64)
+    m = {k: c.value for k, c in sched._m.items()}
+    rng = np.random.default_rng(19)
+
+    def seqs():
+        return [GenSeq(rng.integers(1, VOCAB, size=3), 4, req_id=1),
+                GenSeq(rng.integers(1, VOCAB, size=5), 12, req_id=2),
+                GenSeq(rng.integers(1, VOCAB, size=7), 6, temperature=0.8,
+                       seed=41, req_id=3),
+                # 6 + 30 outgrows the 32-rung ladder top -> truncated
+                GenSeq(rng.integers(1, VOCAB, size=6), 30, req_id=4)]
+
+    first = seqs()
+    for s in first:
+        assert sched.submit(s) is None
+    # a resend of an in-flight (client, req_id) is absorbed silently
+    assert sched.submit(GenSeq(first[0].prompt, 4, req_id=1)) is None
+    assert sched._m["gen_dedup"].value == m["gen_dedup"] + 1
+    replies = _run_to_completion(sched)
+    finals = {r["req_id"]: r for _, r in replies if not r.get("partial")}
+    assert set(finals) == {1, 2, 3, 4}
+    assert all(r["ok"] for r in finals.values())
+    assert len(finals[1]["tokens"]) == 4
+    assert len(finals[2]["tokens"]) == 12
+    assert "truncated" in finals[4] and len(finals[4]["tokens"]) < 30
+    assert sched._m["migrations"].value > m["migrations"]
+    assert sched._m["gen_truncated"].value == m["gen_truncated"] + 1
+    # mid-batch release: short and long budgets finished on their own
+    # schedule, and every slot is back in the pool
+    assert g.slots_active() == 0
+    assert sched._m["decode_batches"].value > m["decode_batches"]
+    # determinism: the same stream (same seeds) emits the same tokens
+    rng = np.random.default_rng(19)
+    again = seqs()
+    for s in again:
+        assert sched.submit(s) is None
+    replies2 = _run_to_completion(sched)
+    finals2 = {r["req_id"]: r for _, r in replies2
+               if not r.get("partial")}
+    for rid in (1, 2, 3, 4):
+        np.testing.assert_array_equal(finals[rid]["tokens"],
+                                      finals2[rid]["tokens"])
+
+
+def test_scheduler_refusals_and_deadline():
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
+                    prompt_rungs=(8, 16))
+    sched = GenerationScheduler(g, max_new_cap=16)
+    ref = sched.submit(GenSeq(np.ones(17, np.uint8), 4))
+    assert ref is not None and "prompt" in ref and ref.policy == "oversized"
+    ref = sched.submit(GenSeq(np.ones(3, np.uint8), 17))
+    assert ref is not None \
+        and "root.common.serving.generate.max_new_tokens" in ref
+    # a pending deadline expiry ships a readable partial
+    s = GenSeq(np.ones(3, np.uint8), 4, deadline_s=-0.01)
+    assert sched.submit(s) is None
+    _, reps = sched.step()
+    timed = [r for _, r in reps if r.get("timed_out")]
+    assert len(timed) == 1 and timed[0]["policy"] == "deadline"
+    assert g.slots_active() == 0
+
+
+# -- e2e service --------------------------------------------------------------
+
+
+def test_e2e_generate_service(_generate_config):
+    """The ``generate`` request kind end-to-end: greedy + seeded
+    determinism over the wire, streamed partials, refusals naming the
+    config knob, neighbor invisibility, truncation, stats export, and
+    jit-cache hygiene over a repeated mixed stream."""
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.serving.client import InferenceError
+
+    wf = _charlm_wf(seq_len=32)
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                          warmup=False).start()
+    cli = InferenceClient(srv.endpoint, timeout=60)
+    rng = np.random.default_rng(23)
+    try:
+        prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
+        # greedy determinism over the wire
+        a = cli.generate(prompt, max_new_tokens=6)
+        b = cli.generate(prompt, max_new_tokens=6)
+        assert a["prompt_len"] == 5 and len(a["tokens"]) == 6
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+        # seeded sampling determinism
+        s1 = cli.generate(prompt, 6, temperature=0.9, top_k=8, seed=37)
+        s2 = cli.generate(prompt, 6, temperature=0.9, top_k=8, seed=37)
+        np.testing.assert_array_equal(s1["tokens"], s2["tokens"])
+        # streamed partials arrive in order and match the final
+        got = []
+        rid = cli.submit_generate(prompt, 6, stream=True,
+                                  on_token=lambda t, i: got.append((i, t)))
+        fin = cli.result(rid)
+        assert [i for i, _ in got] == list(range(6))
+        np.testing.assert_array_equal([t for _, t in got], fin["tokens"])
+        # neighbor invisibility: the greedy probe co-batched with
+        # sampled neighbors answers exactly like it did solo
+        rid_p = cli.submit_generate(prompt, 6)
+        rids = [cli.submit_generate(
+                    rng.integers(1, VOCAB, size=4).astype(np.uint8), 6,
+                    temperature=1.1, seed=100 + k) for k in range(2)]
+        reps = {r: cli.result(r) for r in [rid_p] + rids}
+        np.testing.assert_array_equal(reps[rid_p]["tokens"], a["tokens"])
+        # refusals name the knob / ladder; service stays up
+        with pytest.raises(InferenceError, match="prompt"):
+            cli.generate(np.ones(33, np.uint8), 4)
+        with pytest.raises(InferenceError,
+                           match="generate.max_new_tokens"):
+            cli.generate(prompt, 10 ** 6)
+        # ladder-top truncation is a readable finish, not an error
+        t = cli.generate(prompt, 40)
+        assert t.get("truncated") and len(t["tokens"]) < 40
+        # stats + telemetry surface
+        st = srv.stats()["generate"]
+        assert st["gen_finished"] >= 8 and st["slots_active"] == 0
+        assert st["generated_tokens"] >= 8 * 6
+        assert st["migrations"] >= 1      # the truncated run climbed rungs
+        assert st["inter_token_p99_ms"] is not None
+        # jit-cache hygiene: the same mixed stream again compiles NOTHING
+        warm = srv.runner.compiles
+        cache = srv.gen_sched.gen.jit_cache_size()
+        cli.generate(prompt, 6)
+        cli.generate(prompt, 6, temperature=0.9, top_k=8, seed=37)
+        cli.generate(prompt, 40)
+        assert srv.runner.compiles == warm
+        assert srv.gen_sched.gen.jit_cache_size() in (None, cache)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_generate_disabled_is_refused_readably():
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.serving.client import InferenceError
+
+    root.common.serving.seq.rungs = [8, 32]
+    try:
+        wf = _charlm_wf(seq_len=32)
+        srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                              warmup=False).start()
+        cli = InferenceClient(srv.endpoint, timeout=30)
+        try:
+            with pytest.raises(InferenceError,
+                               match="generate.*enabled|enabled.*generate"):
+                cli.generate(np.ones(3, np.uint8), 4)
+        finally:
+            cli.close()
+            srv.stop()
+    finally:
+        root.common.serving.seq.rungs = None
+
+
+def test_web_status_generation_row(_generate_config):
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+    from znicz_tpu.web_status import WebStatus
+
+    wf = _charlm_wf(seq_len=32)
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                          warmup=False).start()
+    status = WebStatus(port=0).start()
+    cli = InferenceClient(srv.endpoint, timeout=30)
+    try:
+        status.register(wf)
+        status.register_inference(srv)
+        cli.generate(np.ones(5, np.uint8), 6)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status.json") as r:
+            snap = json.load(r)
+        gen = snap["serving"]["generate"]
+        assert gen["gen_finished"] >= 1
+        assert gen["generated_tokens"] >= 6
+        assert gen["cache_rungs"] == [8, 16, 32]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/") as r:
+            page = r.read().decode()
+        assert "generation" in page and "KV slots" in page
+    finally:
+        cli.close()
+        status.stop()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_generate_chaos_soak(_generate_config):
+    """Generations through a ChaosProxy (drop/corrupt/dup/delay both
+    ways): every request eventually answers, resends of in-flight
+    generations are deduplicated (never re-executed), greedy streams
+    stay deterministic, and nothing recompiles after the first pass."""
+    from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    wf = _charlm_wf(seq_len=32)
+    srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                          warmup=False).start()
+    schedule = FaultSchedule(seed=77, drop=0.08, corrupt=0.05,
+                             duplicate=0.08, delay=0.05,
+                             delay_s=(0.005, 0.03))
+    front = "tcp://127.0.0.1:17699"
+    proxy = ChaosProxy(front, srv.endpoint, schedule)
+    proxy.start()
+    cli = InferenceClient(front, timeout=120,
+                          resend_after_s=0.3, breaker_failures=0)
+    rng = np.random.default_rng(29)
+    try:
+        # clean-path references (direct, pre-chaos traffic shapes)
+        ref_cli = InferenceClient(srv.endpoint, timeout=60)
+        prompts = [rng.integers(1, VOCAB, size=int(rng.integers(2, 8))
+                                ).astype(np.uint8) for _ in range(12)]
+        want = [ref_cli.generate(p, 8)["tokens"] for p in prompts]
+        ref_cli.close()
+        # concurrent chaos traffic co-batches deeper than the serial
+        # reference pass — warm the full executable family so the
+        # zero-recompile assert sees a complete baseline
+        srv.gen_sched.gen.warmup()
+        warm = srv.runner.compiles
+        rids = [cli.submit_generate(p, 8) for p in prompts]
+        got = {}
+        deadline = time.time() + 90
+        while len(got) < len(rids) and time.time() < deadline:
+            for rep in cli.collect(0.05):
+                if rep.get("ok") and not rep.get("partial"):
+                    got[rep["req_id"]] = rep["tokens"]
+        assert len(got) == len(rids), (len(got), len(rids))
+        for rid, w in zip(rids, want):
+            np.testing.assert_array_equal(got[rid], w)
+        assert srv.runner.compiles == warm
+        assert srv.gen_sched.gen.slots_active() == 0
+    finally:
+        cli.close()
+        proxy.stop()
+        srv.stop()

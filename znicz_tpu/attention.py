@@ -36,7 +36,8 @@ import numpy as np
 from znicz_tpu.memory import Array
 from znicz_tpu.nn_units import ForwardBase, GradientDescentBase
 from znicz_tpu.ops import activations
-from znicz_tpu.ops.attention import attention, ring_attention
+from znicz_tpu.ops.attention import (attention, cache_append,
+                                     decode_attention, ring_attention)
 
 
 def seq_parallel_size() -> int:
@@ -105,6 +106,42 @@ class MultiHeadAttention(ForwardBase):
         y = o.reshape(b, t, h * d) @ params["wo"]
         return x + y if self.residual else y
 
+    def apply_prefill(self, params, x):
+        """Full-sequence forward that ALSO returns the per-position k/v
+        it computed, so the serving plane can seed a decode cache from
+        the prompt in one pass (ISSUE 16).  Dense core only — a prefill
+        bucket is one device's worth of sequence.  Returns
+        (y, k, v) with k/v shaped (batch, seq, heads, head_dim)."""
+        b, t, e = x.shape
+        h, d = self.heads, self.head_dim
+        q = (x @ params["wq"]).reshape(b, t, h, d)
+        k = (x @ params["wk"]).reshape(b, t, h, d)
+        v = (x @ params["wv"]).reshape(b, t, h, d)
+        o = attention(q, k, v, causal=self.causal)
+        y = o.reshape(b, t, h * d) @ params["wo"]
+        return (x + y if self.residual else y), k, v
+
+    def apply_decode(self, params, x_t, k_cache, v_cache, t):
+        """One autoregressive step: ``x_t`` is this step's hidden row
+        (batch, 1, embed) at per-row global position ``t`` ((batch,)
+        int32); caches are (batch, cache_len, heads, head_dim).  Appends
+        this step's k/v at position ``t`` (so the query always sees at
+        least itself), attends over the prefix ``[0..t]``, and returns
+        ``(y_t, k_row, v_row)`` — the new rows, for the caller to
+        persist (the serving pool scatters just the row, not the whole
+        gathered cache).  The returned ``k_cache``/``v_cache`` are the
+        appended versions used for THIS step's attention."""
+        b, _, e = x_t.shape
+        h, d = self.heads, self.head_dim
+        q = (x_t @ params["wq"]).reshape(b, 1, h, d)
+        k_row = (x_t @ params["wk"]).reshape(b, h, d)
+        v_row = (x_t @ params["wv"]).reshape(b, h, d)
+        k_cache = cache_append(k_cache, k_row, t)
+        v_cache = cache_append(v_cache, v_row, t)
+        o = decode_attention(q, k_cache, v_cache, t)
+        y = o.reshape(b, 1, h * d) @ params["wo"]
+        return (x_t + y if self.residual else y), k_row, v_row
+
     def initialize(self, device=None, **kwargs):
         b, t, e = self.input.shape
         if self.head_dim is None:
@@ -170,6 +207,19 @@ class CharEmbedding(ForwardBase):
         t = x.shape[1]
         return jnp.take(params["embed"], ids, axis=0) \
             + params["pos"][:t][None]
+
+    def apply_decode(self, params, tokens, t):
+        """One decode step's embedding: ``tokens`` is (batch,) — this
+        step's input id per row — at per-row global position ``t``
+        ((batch,) int32).  Returns (batch, 1, embed).  Same tables, same
+        clip, but the position is gathered per ROW instead of sliced
+        from 0 (each co-batched generation sits at its own depth)."""
+        import jax.numpy as jnp
+
+        ids = jnp.clip(tokens.astype(jnp.int32), 0, self.vocab - 1)
+        pos = jnp.clip(t, 0, self.max_len - 1)
+        return (jnp.take(params["embed"], ids, axis=0)
+                + jnp.take(params["pos"], pos, axis=0))[:, None, :]
 
     def initialize(self, device=None, **kwargs):
         b, t = self.input.shape[:2]

@@ -6,6 +6,12 @@ framework handles long sequences at the scale the task demands:
 
   - ``attention(q, k, v, causal)`` — standard scaled-dot-product MHA core,
     one fused jit (XLA flash-fuses the softmax chain on TPU);
+  - ``cache_append(cache, row, t)`` / ``decode_attention(q1, k, v, t)`` —
+    the KV-cache decode step (ISSUE 16): append this step's key/value row
+    at per-row position ``t``, then attend a length-1 query over the
+    prefix ``[0..t]`` of a preallocated cache, the unwritten tail masked
+    by ``k_valid``.  O(cache) per emitted token instead of O(seq^2) for a
+    re-prefill;
   - ``ring_attention(q, k, v, axis_name, causal)`` — blockwise attention
     for SEQUENCE-PARALLEL inputs: every device of the mesh axis holds a
     sequence shard of q/k/v; k/v blocks rotate around the ring via
@@ -30,24 +36,64 @@ def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0,
 
     ``k_valid`` is an optional (batch, k) bool mask of which keys exist —
     the variable-length serving plane's padding mask (ISSUE 15): padded
-    key positions score ``-inf`` so they carry exactly zero probability
-    mass, making each row's output a pure function of its OWN unpadded
-    length.  Each query row must keep at least one valid key (causal
-    rows always see themselves)."""
+    key positions carry exactly zero probability mass, making each row's
+    output a pure function of its OWN unpadded length.
+
+    A query row whose keys are ALL masked (the empty-cache decode edge)
+    returns zeros rather than NaN: masked scores get a finite fill (not
+    ``-inf``, whose ``exp(-inf - -inf)`` poisons the softmax), masked
+    probabilities are zeroed explicitly, and the denominator is clamped.
+    Rows with at least one valid key are bit-identical to the unguarded
+    softmax — the row max is unchanged and the clamped denominator is
+    already >= 1."""
     import jax.numpy as jnp
 
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    dead = None                                        # (b, h, q, k) bcast
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
-        s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
-                      -jnp.inf, s)
+        dead = kpos[None, None, None, :] > qpos[None, None, :, None]
     if k_valid is not None:
-        s = jnp.where(k_valid[:, None, None, :], s, -jnp.inf)
+        miss = ~k_valid[:, None, None, :]
+        dead = miss if dead is None else (dead | miss)
+    if dead is not None:
+        s = jnp.where(dead, jnp.finfo(s.dtype).min, s)
     p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    if dead is not None:
+        p = jnp.where(dead, 0.0, p)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    if dead is not None:
+        denom = jnp.maximum(denom, jnp.finfo(p.dtype).tiny)
+    p = p / denom
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def cache_append(cache, row, t):
+    """Scatter one step's (batch, heads, dim) row into a preallocated
+    (batch, cache_len, heads, dim) cache at per-row position ``t``
+    ((batch,) int32).  Pure — returns the updated cache."""
+    import jax.numpy as jnp
+
+    b = cache.shape[0]
+    return cache.at[jnp.arange(b), t].set(row)
+
+
+def decode_attention(q1, k_cache, v_cache, t):
+    """One autoregressive decode step: a length-1 query at per-row global
+    position ``t`` attends over cache positions ``[0..t]``; the unwritten
+    tail ``(t, cache_len)`` is excluded via ``k_valid``.  ``q1`` is
+    (batch, 1, heads, dim), caches (batch, cache_len, heads, dim), ``t``
+    (batch,) int32.  Callers append this step's k/v row first (so position
+    ``t`` is valid and every row keeps >= 1 valid key).  Equivalent to the
+    causal mask at row ``t`` of a full forward, without the O(seq^2)
+    score matrix."""
+    import jax.numpy as jnp
+
+    cache_len = k_cache.shape[1]
+    k_valid = jnp.arange(cache_len)[None, :] <= t[:, None]
+    return attention(q1, k_cache, v_cache, k_valid=k_valid)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):

@@ -39,9 +39,20 @@ the client, and zero-downtime snapshot rollover (``swap`` control
 command / SIGHUP; every reply carries its snapshot ``gen``) with
 ``/healthz``/``/readyz`` on web_status.
 
+Generation serving (ISSUE 16): with ``root.common.serving.generate.
+enabled`` the frontend also speaks a ``generate`` request kind —
+prompt in, autoregressive tokens out.  One prefill fills a bucketed
+KV-cache slot from the prompt, then O(cache) decode steps emit one
+token each; decode steps from DIFFERENT requests coalesce every tick
+(continuous batching), finished sequences release their slot
+mid-batch, and a cache page migrates up a power-of-two rung when its
+fill outgrows it — the zero-recompile contract extended to the
+(batch rung x cache rung) decode family.
+
 Config home: ``root.common.serving.{max_batch, max_delay_ms,
 queue_bound, request_ttl_s}`` + ``root.common.serving.admission.*``
-+ ``root.common.serving.mesh.*`` (pod-slice sharding, ISSUE 13);
++ ``root.common.serving.mesh.*`` (pod-slice sharding, ISSUE 13)
++ ``root.common.serving.generate.*`` (ISSUE 16);
 CLI: ``python -m znicz_tpu <workflow> --serve [BIND] --snapshot FILE``;
 bench gate: ``python bench.py --serve`` (see README "Serving" and
 "Serving robustness").
@@ -49,8 +60,9 @@ bench gate: ``python bench.py --serve`` (see README "Serving" and
 
 from .balancer import ReplicaBalancer                       # noqa: F401
 from .batcher import (AdmissionPolicy, BucketLadder,        # noqa: F401
-                      DynamicBatcher, Refusal, Request, TokenBucket)
+                      DynamicBatcher, GenerationScheduler, GenSeq,
+                      Refusal, Request, TokenBucket)
 from .client import (CircuitOpenError, InferenceClient,     # noqa: F401
                      InferenceError)
 from .frontend import InferenceServer                       # noqa: F401
-from .model import ModelRunner                              # noqa: F401
+from .model import GenerationRunner, ModelRunner            # noqa: F401

@@ -33,9 +33,25 @@ distinct batch shape is a fresh XLA compile).  The batcher attacks both:
     ``deadline`` / ``draining``) so a client can tell WHICH policy
     refused it.  Config home: ``root.common.serving.admission.*``.
 
+**Continuous batching** (ISSUE 16): :class:`GenerationScheduler` runs
+the autoregressive generation plane next to the classic batcher.
+Prefill (one full forward over the prompt) and decode (one token per
+tick) dispatch as SEPARATE bucket families: every tick, the decode
+steps of ALL live generations sharing a cache rung coalesce into one
+(decode-rung x cache-rung) executable — requests join mid-batch as
+their prefill lands and leave mid-batch the tick they finish (their
+KV slot is released immediately, claimable by the next prefill the
+same tick).  A request outgrowing its cache rung migrates up one rung
+between ticks.  Sampling is host-side per sequence (greedy, or
+seeded temperature/top-k), so a token stream is a deterministic pure
+function of its own prompt + sampling params + the pinned
+executables — co-batched neighbors are invisible.
+
 Threading contract: ``submit`` may be called from the frontend's router
 thread; ``next_batch`` from the single compute thread.  All state is
-guarded by one condition variable.
+guarded by one condition variable.  The scheduler's ``submit`` is
+router-thread too; ``step`` (all compute + slot bookkeeping) runs ONLY
+on the compute thread — one lock guards the handoff queue.
 """
 
 from __future__ import annotations
@@ -775,8 +791,471 @@ class DynamicBatcher:
         }
 
 
+class GenSeq:
+    """One generation request through its whole life: pending (prompt
+    queued, no slot) -> active (slot held, decoding one token per tick)
+    -> finished.  ``t`` is the cache fill: prompt_len after prefill,
+    +1 per decode tick (the input token lands at position ``t``).
+    Sampling state is per-sequence host state — a seeded
+    ``np.random.Generator`` — so the emitted stream is deterministic
+    and independent of co-batched neighbors."""
+
+    __slots__ = ("prompt", "prompt_len", "max_new", "temperature",
+                 "top_k", "rng", "stream", "return_logits", "reply_to",
+                 "req_id", "trace_id", "client", "t_enqueued",
+                 "t_deadline", "rung", "slot", "t", "tokens", "logits",
+                 "gen", "t_last", "order")
+
+    def __init__(self, prompt, max_new: int, temperature: float = 0.0,
+                 top_k: int = 0, seed=None, stream: bool = False,
+                 return_logits: bool = False, reply_to=None, req_id=None,
+                 trace_id=None, client=None, deadline_s=None):
+        import numpy as np
+
+        self.prompt = np.asarray(prompt).reshape(-1)
+        self.prompt_len = int(self.prompt.shape[0])
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.rng = (np.random.default_rng(seed)
+                    if self.temperature > 0 else None)
+        self.stream = bool(stream)
+        self.return_logits = bool(return_logits)
+        self.reply_to = reply_to
+        self.req_id = req_id
+        self.trace_id = trace_id
+        self.client = client
+        self.t_enqueued = time.perf_counter()
+        self.t_deadline = (None if deadline_s is None
+                           else self.t_enqueued + float(deadline_s))
+        self.rung = None                # cache rung once a slot is held
+        self.slot = None
+        self.t = 0                      # cache fill (positions written)
+        self.tokens: List[int] = []     # emitted so far
+        self.logits = [] if return_logits else None
+        self.gen = None                 # snapshot generation stamp
+        self.t_last = None              # last emit time (inter-token)
+        self.order = 0                  # arrival index (FIFO grouping)
+
+    def sample(self, row) -> int:
+        """Next token from one (vocab,) logits row: greedy argmax at
+        temperature 0 (deterministic, tie -> lowest id), else seeded
+        softmax sampling over the optional top-k cut.  Host-side and
+        per-sequence: neighbors share nothing."""
+        import numpy as np
+
+        if self.temperature <= 0:
+            return int(np.argmax(row))
+        z = row.astype(np.float64) / self.temperature
+        if self.top_k > 0 and self.top_k < z.shape[0]:
+            cut = np.partition(z, -self.top_k)[-self.top_k]
+            z = np.where(z >= cut, z, -np.inf)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self.rng.choice(z.shape[0], p=p))
+
+
+class GenerationScheduler:
+    """Continuous batching over a :class:`GenerationRunner` (module
+    docstring).  ``submit`` enqueues from the router thread; ``step``
+    — called by the frontend's compute loop — runs one scheduling
+    round on the compute thread:
+
+      1. expire pending/active sequences past their deadline (partial
+         tokens ship with the ``deadline`` policy reply);
+      2. migrate sequences whose fill reached their cache rung up one
+         rung (or force-finish ``truncated`` at the ladder top);
+      3. ONE decode tick: per cache rung, every live sequence's next
+         token in FIFO chunks of the top decode rung — finished
+         sequences release their slot mid-round;
+      4. ONE prefill batch: same-prompt-rung pending requests coalesce
+         (reaching past other rungs, like the 2-D drain) while slots
+         last — the prompt-side executable family, so a long prompt
+         costs ONE dispatch between decode ticks, never a stall of the
+         decode cadence.
+
+    Returns the replies to ship: streamed per-token partials (opt-in)
+    and whole-stream finals.  A resent ``generate`` request matching an
+    in-flight ``(client, req_id)`` is deduplicated — generation is NOT
+    idempotent compute, but the final reply still is (resend-same-bytes
+    semantics hold end to end)."""
+
+    COUNTERS = {
+        "gen_submitted": "accepted generate requests",
+        "gen_refused": "refused generate requests (policy in the reply)",
+        "gen_dedup": "resent generate requests matched to an in-flight "
+                     "generation (answered by the original)",
+        "prefill_batches": "prefill dispatches — the prompt side of the "
+                           "prefill/decode split",
+        "prefill_seqs": "sequences prefilled",
+        "prefill_tokens": "real prompt tokens prefilled",
+        "decode_batches": "decode tick dispatches — the token side of "
+                          "the prefill/decode split",
+        "decode_tokens": "tokens emitted by decode ticks",
+        "generated_tokens": "tokens emitted in total (prefill's first + "
+                            "every decode)",
+        "migrations": "cache pages migrated up a rung (fill outgrew "
+                      "the rung)",
+        "gen_finished": "generations completed to max_new_tokens",
+        "gen_truncated": "generations force-finished at the cache "
+                         "ladder / position table top",
+        "gen_timed_out": "generations abandoned at their deadline "
+                         "(partial tokens shipped)",
+    }
+
+    def __init__(self, gen_runner, max_new_cap: int = 256,
+                 pending_bound: int = 64, decode_tick_ms: float = 0.0,
+                 replica_id: str = ""):
+        from znicz_tpu import telemetry
+
+        self.gen = gen_runner
+        self.max_new_cap = int(max_new_cap)
+        self.pending_bound = int(pending_bound)
+        self.decode_tick_s = float(decode_tick_ms) / 1e3
+        self.replica_id = replica_id
+        self._lock = threading.Lock()
+        self._pending: collections.deque = collections.deque()
+        self._active: List[GenSeq] = []
+        #: in-flight (client, req_id) pairs — the resend dedup set
+        self._inflight = set()
+        self._closed = False
+        self._order = 0
+        self._next_tick = 0.0
+        _sc = telemetry.scope("generate")
+        self._m = {name: _sc.counter(name, help)
+                   for name, help in self.COUNTERS.items()}
+        self._m_inter_token = _sc.histogram(
+            "inter_token_seconds",
+            "gap between consecutive emitted tokens of one sequence",
+            size=8192)
+        _sc.gauge("kv_occupancy", "active KV slots / total slots",
+                  fn=telemetry.weak_fn(self, lambda s: s.gen.occupancy()))
+        _sc.gauge("active", "generations holding a KV slot",
+                  fn=telemetry.weak_fn(self, lambda s: len(s._active)))
+        _sc.gauge("pending", "generations queued for prefill",
+                  fn=telemetry.weak_fn(self, lambda s: len(s._pending)))
+
+    # -- producer side (router thread) -----------------------------------------
+
+    def _prompt_rung(self, n: int) -> Optional[int]:
+        for r in self.gen.prompt_rungs:
+            if n <= r:
+                return r
+        return None
+
+    def submit(self, seq: GenSeq) -> Optional[Refusal]:
+        """Queue one generation, or refuse readably.  A resend of an
+        in-flight (client, req_id) is absorbed (None — the original
+        generation answers it)."""
+        if seq.prompt_len < 1 or self._prompt_rung(seq.prompt_len) is None:
+            self._m["gen_refused"].inc()
+            return Refusal(
+                "oversized",
+                f"prompt of {seq.prompt_len} tokens outside the prompt "
+                f"ladder (1..{self.gen.prompt_rungs[-1]})", scope="client")
+        if seq.max_new < 1 or seq.max_new > self.max_new_cap:
+            self._m["gen_refused"].inc()
+            return Refusal(
+                "oversized",
+                f"max_new_tokens={seq.max_new} outside 1.."
+                f"{self.max_new_cap} "
+                f"(root.common.serving.generate.max_new_tokens)",
+                scope="client")
+        key = (seq.client, seq.req_id)
+        with self._lock:
+            if self._closed:
+                return Refusal("draining", "service is shutting down")
+            if seq.req_id is not None and key in self._inflight:
+                self._m["gen_dedup"].inc()
+                return None
+            if len(self._pending) >= self.pending_bound:
+                self._m["gen_refused"].inc()
+                return Refusal(
+                    "shed",
+                    f"generation queue at bound ({len(self._pending)} "
+                    f"pending, bound {self.pending_bound}) — shed")
+            seq.order = self._order
+            self._order += 1
+            self._pending.append(seq)
+            self._inflight.add(key)
+            self._m["gen_submitted"].inc()
+            return None
+
+    def in_flight(self, client, req_id) -> bool:
+        """Is this (client, req_id) currently queued or generating?
+        The frontend answers a RESEND of an in-flight generation with a
+        heartbeat partial — the client's resend timer refreshes without
+        re-executing anything, so a long generation (queued behind slot
+        pressure or just slow) never burns the resend cap of a healthy
+        service."""
+        with self._lock:
+            return (client, req_id) in self._inflight
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    # -- consumer side (compute thread) ----------------------------------------
+
+    def work_available(self) -> bool:
+        return bool(self._pending or self._active)
+
+    def work_ready(self, now: Optional[float] = None) -> bool:
+        """True when step() would do compute RIGHT NOW (pending prefill,
+        or active sequences with the decode tick pacing window open) —
+        the compute loop's busy/idle poll hint."""
+        if self._pending:
+            return True
+        if not self._active:
+            return False
+        now = time.perf_counter() if now is None else now
+        return now >= self._next_tick
+
+    def _retire(self, seq: GenSeq) -> None:
+        """Drop a sequence from the live sets (lock taken here; slot
+        release is the caller's — compute thread owns the pool)."""
+        with self._lock:
+            if seq in self._active:
+                self._active.remove(seq)
+            self._inflight.discard((seq.client, seq.req_id))
+
+    def _final(self, seq: GenSeq, replies, truncated: Optional[str] = None,
+               counter: str = "gen_finished") -> None:
+        import numpy as np
+
+        if seq.slot is not None:
+            self.gen.release(seq.rung, seq.slot)
+            seq.slot = None
+        self._retire(seq)
+        self._m[counter].inc()
+        rep = {"ok": True, "req_id": seq.req_id,
+               "replica_id": self.replica_id,
+               "tokens": np.asarray(seq.tokens, np.int32),
+               "gen": seq.gen, "prompt_len": seq.prompt_len,
+               "trace_id": seq.trace_id}
+        if truncated:
+            rep["truncated"] = truncated
+        if seq.logits is not None:
+            rep["logits"] = (np.stack(seq.logits) if seq.logits
+                             else np.zeros((0, 0), np.float32))
+        replies.append((seq.reply_to, rep))
+
+    def _expire(self, seq: GenSeq, replies) -> None:
+        import numpy as np
+
+        if seq.slot is not None:
+            self.gen.release(seq.rung, seq.slot)
+            seq.slot = None
+        self._retire(seq)
+        self._m["gen_timed_out"].inc()
+        replies.append((seq.reply_to, {
+            "ok": False, "timed_out": True, "req_id": seq.req_id,
+            "replica_id": self.replica_id, "policy": "deadline",
+            "tokens": np.asarray(seq.tokens, np.int32),
+            "gen": seq.gen, "trace_id": seq.trace_id,
+            "error": "deadline expired mid-generation "
+                     f"({len(seq.tokens)} of {seq.max_new} tokens "
+                     "emitted — shipped partial)"}))
+
+    def _emit(self, seq: GenSeq, token: int, row, now: float,
+              replies) -> None:
+        seq.tokens.append(int(token))
+        if seq.logits is not None:
+            seq.logits.append(row.copy())
+        if seq.t_last is not None:
+            self._m_inter_token.observe(now - seq.t_last)
+        seq.t_last = now
+        self._m["generated_tokens"].inc()
+        if seq.stream and seq.reply_to is not None:
+            replies.append((seq.reply_to, {
+                "ok": True, "partial": True, "req_id": seq.req_id,
+                "replica_id": self.replica_id, "token": int(token),
+                "i": len(seq.tokens) - 1, "trace_id": seq.trace_id}))
+
+    def step(self):
+        """One scheduling round (class docstring).  Returns ``(worked,
+        replies)``: whether any compute dispatched, and the
+        ``(reply_to, payload)`` pairs to ship."""
+        import numpy as np
+
+        replies: List = []
+        worked = False
+        now = time.perf_counter()
+        # 1. deadlines — pending first (never prefill doomed work)
+        with self._lock:
+            doomed_p = [s for s in self._pending
+                        if s.t_deadline is not None and now > s.t_deadline]
+            for s in doomed_p:
+                self._pending.remove(s)
+            doomed_a = [s for s in self._active
+                        if s.t_deadline is not None and now > s.t_deadline]
+        for s in doomed_p + doomed_a:
+            self._expire(s, replies)
+        # 2. migrations / ladder-top truncation, 3. one decode tick —
+        # DISPATCHED, not yet fetched
+        chunks = []
+        if self._active and now >= self._next_tick:
+            stalled = set()
+            for seq in list(self._active):
+                if seq.t < seq.rung:
+                    continue
+                dst = self.gen._rung_for(seq.t + 1)
+                if dst is None:
+                    self._final(seq, replies, truncated="cache ladder "
+                                "exhausted", counter="gen_truncated")
+                    continue
+                slot = self.gen.alloc(dst)
+                if slot is None:
+                    stalled.add(id(seq))    # waits for a release
+                    continue
+                self.gen.migrate(seq.rung, seq.slot, dst, slot)
+                self.gen.release(seq.rung, seq.slot)
+                seq.rung, seq.slot = dst, slot
+                self._m["migrations"].inc()
+                worked = True
+            groups: Dict[int, List[GenSeq]] = {}
+            for seq in self._active:
+                if id(seq) not in stalled:
+                    groups.setdefault(seq.rung, []).append(seq)
+            # dispatch EVERY chunk of the tick before fetching any:
+            # chunk N's device compute overlaps chunk N-1's host-side
+            # sampling and reply shipping (decode_async contract)
+            chunk_max = self.gen.decode_rungs[-1]
+            for rung in sorted(groups):
+                grp = sorted(groups[rung], key=lambda s: s.order)
+                for lo in range(0, len(grp), chunk_max):
+                    chunk = grp[lo:lo + chunk_max]
+                    dev, gen = self.gen.decode_async(
+                        rung, [s.slot for s in chunk],
+                        [s.tokens[-1] for s in chunk],
+                        [s.t for s in chunk])
+                    chunks.append((chunk, dev, gen))
+                    self._m["decode_batches"].inc()
+                    self._m["decode_tokens"].inc(len(chunk))
+                    worked = True
+            if groups and self.decode_tick_s > 0:
+                self._next_tick = now + self.decode_tick_s
+        # 4. one prefill batch: head's prompt rung, reach past others.
+        # Dispatched BETWEEN the decode dispatches and their fetches —
+        # prompt compute overlaps this tick's decode sampling.  (Slots
+        # released by this tick's finishers become claimable next
+        # round; slots freed by phases 1-2 are already in the pool.)
+        batch: List[GenSeq] = []
+        cache_rung = None
+        s_rung = None
+        with self._lock:
+            if self._pending:
+                head = self._pending[0]
+                s_rung = self._prompt_rung(head.prompt_len)
+                cache_rung = self.gen._rung_for(s_rung)
+                cap = self.gen.prefill_rungs[-1]
+                for seq in list(self._pending):
+                    if len(batch) >= cap:
+                        break
+                    if self._prompt_rung(seq.prompt_len) != s_rung:
+                        continue
+                    slot = self.gen.alloc(cache_rung)
+                    if slot is None:
+                        break               # pool full: head waits
+                    seq.rung, seq.slot = cache_rung, slot
+                    batch.append(seq)
+                for seq in batch:
+                    self._pending.remove(seq)
+        pf = None
+        if batch:
+            x = np.zeros((len(batch), s_rung), self.gen.runner.dtype)
+            lengths = np.ones((len(batch),), np.int32)
+            for i, seq in enumerate(batch):
+                x[i, :seq.prompt_len] = seq.prompt
+                lengths[i] = seq.prompt_len
+            pf = self.gen.prefill_async(x, lengths, cache_rung,
+                                        [s.slot for s in batch])
+            self._m["prefill_batches"].inc()
+            self._m["prefill_seqs"].inc(len(batch))
+            self._m["prefill_tokens"].inc(int(lengths.sum()))
+            worked = True
+        # fetch + emit: decode chunks first (oldest dispatches), then
+        # the prefill's first tokens
+        for chunk, dev, gen in chunks:
+            logits = np.asarray(dev)[:len(chunk)]
+            t_emit = time.perf_counter()
+            for i, seq in enumerate(chunk):
+                seq.t += 1
+                seq.gen = gen
+                self._emit(seq, seq.sample(logits[i]), logits[i],
+                           t_emit, replies)
+                if len(seq.tokens) >= seq.max_new:
+                    self._final(seq, replies)
+        if pf is not None:
+            logits = np.asarray(pf[0])[:len(batch)]
+            gen = pf[1]
+            t_emit = time.perf_counter()
+            with self._lock:
+                self._active.extend(batch)
+            for i, seq in enumerate(batch):
+                seq.t = seq.prompt_len
+                seq.gen = gen
+                self._emit(seq, seq.sample(logits[i]), logits[i],
+                           t_emit, replies)
+                if len(seq.tokens) >= seq.max_new:
+                    self._final(seq, replies)
+        return worked, replies
+
+    def drain(self) -> List:
+        """Abandon every queued/live generation (service shutdown):
+        readable ``draining`` replies for all, slots released."""
+        replies: List = []
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+            active = list(self._active)
+        for seq in pending + active:
+            if seq.slot is not None:
+                self.gen.release(seq.rung, seq.slot)
+                seq.slot = None
+            self._retire(seq)
+            self._m["gen_refused"].inc()
+            replies.append((seq.reply_to, {
+                "ok": False, "rejected": True, "req_id": seq.req_id,
+                "replica_id": self.replica_id, "policy": "draining",
+                "trace_id": seq.trace_id,
+                "error": "service is shutting down — generation "
+                         "abandoned"}))
+        return replies
+
+    # -- stats -----------------------------------------------------------------
+
+    def inter_token_quantiles(self) -> Dict[str, Optional[float]]:
+        import numpy as np
+
+        w = self._m_inter_token.window()
+        if w.size == 0:
+            return {"inter_token_p50_ms": None, "inter_token_p99_ms": None}
+        return {"inter_token_p50_ms":
+                round(float(np.percentile(w, 50)) * 1e3, 3),
+                "inter_token_p99_ms":
+                round(float(np.percentile(w, 99)) * 1e3, 3)}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            pending = len(self._pending)
+            active = len(self._active)
+        out = {"pending": pending, "active": active,
+               "max_new_tokens": self.max_new_cap,
+               "pending_bound": self.pending_bound,
+               "decode_tick_ms": self.decode_tick_s * 1e3}
+        out.update({name: self._m[name].value for name in self.COUNTERS})
+        out.update(self.inter_token_quantiles())
+        out.update({k: v for k, v in self.gen.stats().items()
+                    if k != "jit_cache_size"})
+        return out
+
+
 # historical counter attributes, generated from COUNTERS (name + HELP
 # defined exactly once)
 for _name, _help in DynamicBatcher.COUNTERS.items():
     setattr(DynamicBatcher, _name, registered_property(_name, _help))
+for _name, _help in GenerationScheduler.COUNTERS.items():
+    setattr(GenerationScheduler, _name, registered_property(_name, _help))
 del _name, _help

@@ -137,6 +137,8 @@ class InferenceClient:
         #: req_id -> [frames, t_last_sent, resends]
         self._pending: Dict[int, List] = {}
         self._results: Dict[int, dict] = {}
+        #: req_id -> callback for streamed generation tokens (ISSUE 16)
+        self._on_token: Dict[int, object] = {}
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.DEALER)
         self._sock.setsockopt(zmq.LINGER, 0)
@@ -308,8 +310,24 @@ class InferenceClient:
                 self._m["bad_replies"].inc()
                 continue
             rid = rep.get("req_id")
+            if rep.get("partial"):
+                # streamed generation token (ISSUE 16): progress, not
+                # the answer — refresh the resend timer (the service is
+                # plainly alive and working THIS request; re-shipping
+                # the prompt would only burn dedup work) and hand the
+                # token to the caller's callback
+                entry = self._pending.get(rid)
+                if entry is not None:
+                    entry[1] = time.perf_counter()
+                    entry[2] = 0
+                    cb = self._on_token.get(rid)
+                    # dedup heartbeats carry no token — timer-only
+                    if cb is not None and "token" in rep:
+                        cb(rep.get("token"), rep.get("i"))
+                continue
             if rid in self._pending:
                 del self._pending[rid]
+                self._on_token.pop(rid, None)
                 self._results[rid] = rep
                 # breaker outcome: ok replies and PER-CLIENT refusals
                 # count as healthy; only a SERVICE-scoped shed (global
@@ -368,6 +386,7 @@ class InferenceClient:
                 # misattribute request A's death to a caller waiting
                 # on request B (and silently lose A's outcome)
                 del self._pending[rid]
+                self._on_token.pop(rid, None)
                 self._m["give_ups"].inc()
                 self._breaker_record(rid, False)
                 self._results[rid] = {
@@ -431,6 +450,68 @@ class InferenceClient:
         1-row axis)."""
         return self.result(self.submit(x, deadline_s=deadline_s),
                            timeout=timeout)["y"]
+
+    # -- generation (ISSUE 16) -------------------------------------------------
+
+    def submit_generate(self, prompt: np.ndarray, max_new_tokens: int,
+                        temperature: float = 0.0, top_k: int = 0,
+                        seed: Optional[int] = None, stream: bool = False,
+                        return_logits: bool = False,
+                        deadline_s: Optional[float] = None,
+                        on_token=None) -> int:
+        """Send one ``generate`` request (pipelined form); returns its
+        ``req_id``.  With ``stream=True`` the service ships every
+        decoded token as it lands and ``on_token(token, i)`` fires from
+        whichever pump happens to be draining — the final reply (the
+        whole token array) still arrives through ``result()``.  Ship a
+        ``seed`` with ``temperature > 0`` if a resend must reproduce
+        the same stream (sampling is host-side and seeded)."""
+        self._breaker_admit()
+        msg = {"cmd": "generate",
+               "x": np.ascontiguousarray(np.asarray(prompt).reshape(-1)),
+               "max_new_tokens": int(max_new_tokens)}
+        if temperature:
+            msg["temperature"] = float(temperature)
+        if top_k:
+            msg["top_k"] = int(top_k)
+        if seed is not None:
+            msg["seed"] = int(seed)
+        if stream:
+            msg["stream"] = True
+        if return_logits:
+            msg["return_logits"] = True
+        budget = self.deadline_s if deadline_s is None else float(deadline_s)
+        if budget > 0:
+            msg["deadline_ms"] = budget * 1e3
+        try:
+            rid = self._send(msg)
+        except Exception:
+            self._breaker.release_probe()
+            raise
+        self._breaker.arm_probe(rid)
+        if on_token is not None:
+            self._on_token[rid] = on_token
+        return rid
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: Optional[int] = None, stream: bool = False,
+                 return_logits: bool = False,
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None, on_token=None) -> dict:
+        """One generation, synchronously: the final reply dict —
+        ``tokens`` (the (max_new_tokens,) int32 stream), ``gen`` (the
+        snapshot generation that produced them), ``prompt_len``, and
+        ``logits`` when requested.  Size ``timeout`` to the whole
+        generation, not one token."""
+        return self.result(
+            self.submit_generate(prompt, max_new_tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 seed=seed, stream=stream,
+                                 return_logits=return_logits,
+                                 deadline_s=deadline_s,
+                                 on_token=on_token),
+            timeout=timeout)
 
     def close(self) -> None:
         self._sock.close(0)

@@ -52,7 +52,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.telemetry.metrics import registered_property
 
 from .batcher import (AdmissionPolicy, BucketLadder, DynamicBatcher,
-                      Request)
+                      GenerationScheduler, GenSeq, Request)
 from .model import ModelRunner
 
 #: serving config home: ``root.common.serving.*`` (CLI dotted overrides
@@ -70,6 +70,19 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             # Importing a sequence sample (charlm) defaults max_len to
             # its trained window.
             "seq": {"max_len": 0, "rungs": None},
+            # generation serving (ISSUE 16): prefill/decode split over
+            # a bucketed KV-cache pool with continuous batching.  Off
+            # by default — scoring-only services pay nothing.  With
+            # enabled=True (needs the seq plane for the prompt ladder):
+            # ``max_new_tokens`` caps any one request's decode budget,
+            # ``cache_rungs`` overrides the power-of-two KV cache-length
+            # ladder (default: powers of two up to seq max_len),
+            # ``slots`` bounds concurrent generations per cache rung,
+            # ``decode_tick_ms`` paces the decode cadence (0 = free-
+            # running), ``pending_bound`` sheds prompt arrivals past it
+            "generate": {"enabled": False, "max_new_tokens": 256,
+                         "cache_rungs": None, "slots": 8,
+                         "decode_tick_ms": 0.0, "pending_bound": 64},
             # serving mesh (ISSUE 13; serving/model.py reads it through
             # a local alias): NamedSharding axis sizes — requests split
             # over ``data``, wide FC tails column-shard over ``model``.
@@ -223,6 +236,41 @@ class InferenceServer:
             ladder=ladder,
             admission=admission or _admission_from_config())
         self.request_ttl_s = float(_cfg("request_ttl_s", request_ttl_s))
+        # generation serving (ISSUE 16; knobs read through a local
+        # alias like the admission subtree): a GenerationRunner (KV-
+        # cache pool + prefill/decode executables) under a continuous-
+        # batching scheduler, driven by the SAME compute thread
+        d_gen = DEFAULTS["generate"]
+        gn = root.common.serving.generate
+        self.gen_sched: Optional[GenerationScheduler] = None
+        if bool(gn.get("enabled", d_gen["enabled"])):
+            if self.seq_max_len is None:
+                raise ValueError(
+                    "generation serving rides the variable-length "
+                    "plane (the prompt ladder IS the seq ladder) — "
+                    "set root.common.serving.seq.max_len alongside "
+                    "root.common.serving.generate.enabled")
+            rungs = gn.get("cache_rungs", d_gen["cache_rungs"])
+            if rungs is None:
+                # power-of-two cache-length ladder up to the serving
+                # window — the zero-recompile contract's rung set
+                top = self.seq_max_len
+                rungs = [r for r in (8, 16, 32, 64, 128, 256, 512,
+                                     1024, 2048, 4096) if r < top]
+                rungs.append(top)
+            gr = self.runner.enable_generation(
+                cache_rungs=[int(r) for r in rungs],
+                slots=int(gn.get("slots", d_gen["slots"])),
+                prompt_rungs=list(self.batcher.ladder.seq_rungs))
+            self.gen_sched = GenerationScheduler(
+                gr,
+                max_new_cap=int(gn.get("max_new_tokens",
+                                       d_gen["max_new_tokens"])),
+                pending_bound=int(gn.get("pending_bound",
+                                         d_gen["pending_bound"])),
+                decode_tick_ms=float(gn.get("decode_tick_ms",
+                                            d_gen["decode_tick_ms"])),
+                replica_id=self.replica_id)
         self.max_requests = max_requests
         self._warmup = warmup
         self.codec = wire.Codec(owner="serving")    # router-thread only
@@ -273,7 +321,7 @@ class InferenceServer:
     #: serving counters registered under component="serving" (ISSUE 5):
     #: name -> HELP text
     COUNTERS = {
-        "requests_in": "decoded infer requests",
+        "requests_in": "decoded infer/generate requests",
         "served": "answered with a result",
         "timed_out": "answered timed_out (deadline/TTL)",
         "rejected": "answered shed/oversized/rate_limited",
@@ -359,6 +407,8 @@ class InferenceServer:
         out["heartbeats_out"] = self.heartbeats_out
         out["batcher"] = self.batcher.stats()
         out["model"] = self.runner.stats()
+        if self.gen_sched is not None:
+            out["generate"] = self.gen_sched.stats()
         return out
 
     # -- lifecycle -------------------------------------------------------------
@@ -387,6 +437,8 @@ class InferenceServer:
     def stop(self) -> None:
         self._stop.set()
         self.batcher.close()
+        if self.gen_sched is not None:
+            self.gen_sched.close()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
@@ -493,6 +545,11 @@ class InferenceServer:
                 # resolve the output-shape probe now (cache hits after
                 # warmup), never on the compute thread mid-traffic
                 self._resolve_seq_out()
+            if self.gen_sched is not None and self._warmup:
+                # the generation executable families (prefill x prompt
+                # rungs, decode x cache rungs, migrations) compile
+                # up-front too — the zero-recompile gate's baseline
+                self.gen_sched.gen.warmup()
             self.started_at = time.perf_counter()
             self._compute_thread = threading.Thread(
                 target=self._compute_loop, daemon=True,
@@ -528,6 +585,8 @@ class InferenceServer:
         finally:
             self._stop.set()
             self.batcher.close()
+            if self.gen_sched is not None:
+                self.gen_sched.close()
             if self._compute_thread is not None:
                 self._compute_thread.join(timeout=30)
             if sock is not None:
@@ -628,6 +687,9 @@ class InferenceServer:
                 {"ok": True, "rolled_back": True, "req_id": rid,
                  "replica_id": self.replica_id, "generation": gen}))
             return
+        if cmd == "generate":
+            self._handle_generate(sock, envelope, req, rid)
+            return
         if cmd != "infer":
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "req_id": rid,
@@ -680,38 +742,21 @@ class InferenceServer:
                           f"{self.runner.dtype}"}))
             return
         self._m["requests_in"].inc()
-        # admission identity: explicit ``client`` metadata when the
-        # peer ships one (the InferenceClient does), else a digest of
-        # the ROUTER envelope — still distinct per client through a
-        # proxy, because the client's own identity frame rides inside
-        client = req.get("client")
-        if not isinstance(client, str) or not client:
-            client = "peer-%08x" % (zlib.crc32(
-                b"".join(bytes(f) for f in envelope)) & 0xFFFFFFFF)
+        client = self._client_id(req, envelope)
         # deadline ingress (ISSUE 6): the client's shipped budget
         # becomes a LOCAL absolute deadline here (budgets, not
         # timestamps, cross the wire — clocks differ); the server's
         # request_ttl_s stays the cap.  Re-checked at assemble time and
         # post-compute: expired work is never computed, never shipped.
-        deadline_s = self.request_ttl_s
-        budget_ms = req.get("deadline_ms")
-        if budget_ms is not None:
-            try:
-                budget_s = float(budget_ms) / 1e3
-            except (TypeError, ValueError):
-                budget_s = float("nan")
-            # non-finite budgets are garbage too: min(nan, ttl) is nan,
-            # and a nan deadline fails every later expiry check — a
-            # client could disable the TTL outright with one bad float
-            if math.isfinite(budget_s):
-                deadline_s = min(budget_s, deadline_s)
+        deadline_s = self._deadline_s(req)
         if deadline_s <= 0:
             self._m["timed_out"].inc()
             sock.send_multipart(list(envelope) + self.codec.encode(
                 {"ok": False, "timed_out": True, "req_id": rid,
                  "replica_id": self.replica_id,
                  "policy": "deadline", "trace_id": req.get("trace_id"),
-                 "error": f"deadline budget {budget_ms}ms already "
+                 "error": f"deadline budget "
+                          f"{req.get('deadline_ms')}ms already "
                           f"expended — refused at ingress"}))
             return
         reason = self.batcher.submit(
@@ -726,6 +771,113 @@ class InferenceServer:
                  "policy": getattr(reason, "policy", "refused"),
                  "scope": getattr(reason, "scope", "service"),
                  "trace_id": req.get("trace_id"), "error": str(reason)}))
+
+    def _client_id(self, req, envelope) -> str:
+        """Admission identity: explicit ``client`` metadata when the
+        peer ships one (the InferenceClient does), else a digest of the
+        ROUTER envelope — still distinct per client through a proxy,
+        because the client's own identity frame rides inside."""
+        client = req.get("client")
+        if isinstance(client, str) and client:
+            return client
+        return "peer-%08x" % (zlib.crc32(
+            b"".join(bytes(f) for f in envelope)) & 0xFFFFFFFF)
+
+    def _deadline_s(self, req) -> float:
+        """Relative deadline budget for one request: the client-shipped
+        ``deadline_ms`` capped by ``request_ttl_s``.  Non-finite
+        budgets are garbage: min(nan, ttl) is nan, and a nan deadline
+        fails every later expiry check — a client could disable the
+        TTL outright with one bad float."""
+        deadline_s = self.request_ttl_s
+        budget_ms = req.get("deadline_ms")
+        if budget_ms is not None:
+            try:
+                budget_s = float(budget_ms) / 1e3
+            except (TypeError, ValueError):
+                budget_s = float("nan")
+            if math.isfinite(budget_s):
+                deadline_s = min(budget_s, deadline_s)
+        return deadline_s
+
+    def _handle_generate(self, sock, envelope, req, rid) -> None:
+        """The ``generate`` request kind (ISSUE 16): a 1-D token
+        prompt in, ``max_new_tokens`` autoregressive tokens out —
+        streamed per-token (``stream``) or returned whole.  Queued on
+        the continuous-batching scheduler; the final reply ships from
+        the compute loop."""
+        if self.gen_sched is None:
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
+                 "error": "generation serving is disabled — start the "
+                          "service with root.common.serving.generate."
+                          "enabled=True"}))
+            return
+        x = req.get("x")
+        if not isinstance(x, np.ndarray) or x.ndim != 1 or x.size < 1 \
+                or not np.issubdtype(x.dtype, np.number):
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
+                 "error": "generate request needs a non-empty 1-D "
+                          "numeric token prompt 'x'"}))
+            return
+        self._m["requests_in"].inc()
+        deadline_s = self._deadline_s(req)
+        if deadline_s <= 0:
+            self._m["timed_out"].inc()
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "timed_out": True, "req_id": rid,
+                 "replica_id": self.replica_id,
+                 "policy": "deadline", "trace_id": req.get("trace_id"),
+                 "error": f"deadline budget "
+                          f"{req.get('deadline_ms')}ms already "
+                          f"expended — refused at ingress"}))
+            return
+        client = self._client_id(req, envelope)
+        dup = rid is not None and self.gen_sched.in_flight(client, rid)
+        try:
+            seq = GenSeq(
+                x, max_new=int(req.get("max_new_tokens", 0) or 0),
+                temperature=float(req.get("temperature", 0.0) or 0.0),
+                top_k=int(req.get("top_k", 0) or 0),
+                seed=req.get("seed"),
+                stream=bool(req.get("stream", False)),
+                return_logits=bool(req.get("return_logits", False)),
+                reply_to=list(envelope), req_id=rid,
+                trace_id=req.get("trace_id"),
+                client=client,
+                deadline_s=deadline_s)
+        except (TypeError, ValueError) as exc:
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "req_id": rid,
+                 "replica_id": self.replica_id,
+                 "error": f"bad generate parameters: {exc}"}))
+            return
+        reason = self.gen_sched.submit(seq)
+        if reason is None and dup:
+            # a resend matched an in-flight generation: answer with a
+            # heartbeat partial — refreshes the client's resend timer
+            # (generations outlive the resend window routinely; a
+            # silent dedup would let a healthy long generation burn the
+            # client's resend cap into a give-up)
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": True, "partial": True, "heartbeat": True,
+                 "req_id": rid, "replica_id": self.replica_id,
+                 "trace_id": req.get("trace_id")}))
+            return
+        if reason is not None:
+            self._m["rejected"].inc()
+            sock.send_multipart(list(envelope) + self.codec.encode(
+                {"ok": False, "rejected": True, "req_id": rid,
+                 "replica_id": self.replica_id,
+                 "policy": getattr(reason, "policy", "refused"),
+                 "scope": getattr(reason, "scope", "service"),
+                 "trace_id": req.get("trace_id"),
+                 "error": str(reason)}))
+        # accepted (or deduplicated onto an in-flight generation):
+        # tokens arrive from the compute loop's scheduler rounds
 
     # -- the compute loop (donated ping-pong) ----------------------------------
 
@@ -881,14 +1033,38 @@ class InferenceServer:
             except zmq.Again:           # router already has wakes queued
                 pass
 
+        gs = self.gen_sched
+
+        def gen_step() -> bool:
+            # one continuous-batching round (migrate / decode tick /
+            # prefill batch); its replies queue for the router thread
+            worked, replies = gs.step()
+            self._ship_gen(replies, poke)
+            return worked or bool(replies)
+
         staged = None
         try:
             while True:
                 if staged is None:
-                    batch = self.batcher.next_batch(timeout=0.05)
+                    # with generation work ready RIGHT NOW the classic
+                    # queue gets a zero-wait poll (decode cadence must
+                    # not wait out the coalescing window)
+                    timeout = 0.0 if (gs is not None
+                                      and gs.work_ready()) else 0.05
+                    batch = self.batcher.next_batch(timeout=timeout)
                     if batch is None:
                         if self._stop.is_set():
+                            if gs is not None:
+                                # abandon queued/live generations with
+                                # readable draining replies
+                                self._ship_gen(gs.drain(), poke)
                             return
+                        if gs is not None and not gen_step() \
+                                and timeout == 0.0:
+                            # ready-but-stalled edge (every active
+                            # sequence waiting on a migration slot):
+                            # don't spin hot against the pool
+                            time.sleep(0.001)
                         continue
                     staged = self._assemble(batch)
                     if staged is None:
@@ -911,13 +1087,36 @@ class InferenceServer:
                     staged = self._assemble(nxt)
                 self._finish(live, y_dev, gen, t_dispatch)
                 poke()                  # replies queued: wake the router
+                if gs is not None and gs.work_ready():
+                    gen_step()          # interleave under mixed traffic
         except Exception:
             # a compute-thread death must not strand clients silently
             self.log.exception("inference compute loop died")
             self._stop.set()
             self.batcher.close()
+            if self.gen_sched is not None:
+                self.gen_sched.close()
         finally:
             wake.close(0)
+
+    def _ship_gen(self, replies, poke=None) -> None:
+        """Queue generation replies for the router thread.  Finals
+        count into served/timed_out/rejected (and so toward
+        ``max_requests``); streamed partials are progress, not
+        answers."""
+        for env, rep in replies:
+            if env is None:
+                continue
+            if not rep.get("partial"):
+                if rep.get("ok"):
+                    self._m["served"].inc()
+                elif rep.get("timed_out"):
+                    self._m["timed_out"].inc()
+                else:
+                    self._m["rejected"].inc()
+            self._outbound.put((env, rep, None))
+        if replies and poke is not None:
+            poke()
 
 
 for _name, _help in InferenceServer.COUNTERS.items():

@@ -35,6 +35,14 @@ tuple exactly once, every request is answered entirely by one snapshot
 generation (the ``gen`` id in each reply proves it).  A failed load or
 warm leaves the served generation untouched.
 
+**Autoregressive generation** (ISSUE 16): :meth:`enable_generation`
+builds a :class:`GenerationRunner` — a bucketed KV-cache pool plus
+three more jitted functions (prefill, decode, migrate) that share the
+runner's ``compiles`` counter, so the zero-recompile contract extends
+over the whole generation executable family: ``prefill_rungs x
+prompt_rungs + decode_rungs x cache_rungs + (cache_rungs - 1)``
+executables, warmed up front, zero traces after.
+
 **Pod-scale sharding** (ISSUE 13): with ``root.common.serving.mesh.*``
 set (``data``/``model`` axis sizes; default 1x1 = exactly the
 single-device path above), the runner goes mesh-native: params are
@@ -150,6 +158,8 @@ class ModelRunner:
         #                                     warmup dispatches
         self._chaos = None                  # FaultSchedule, or None
         self._m_stalls = None
+        #: GenerationRunner once enable_generation() ran (ISSUE 16)
+        self.gen_runner: Optional["GenerationRunner"] = None
         #: per-sample input shape the service accepts (requests carry
         #: (n, *sample_shape) arrays)
         self.sample_shape: Tuple[int, ...] = tuple(
@@ -477,6 +487,20 @@ class ModelRunner:
         finally:
             self._swap_lock.release()
 
+    def enable_generation(self, cache_rungs, slots: int, prompt_rungs,
+                          prefill_rungs=None, decode_rungs=None
+                          ) -> "GenerationRunner":
+        """Build the autoregressive generation path (ISSUE 16): a
+        bucketed KV-cache pool plus jitted prefill/decode/migrate
+        functions over this runner's live params.  Idempotent per
+        runner; returns the :class:`GenerationRunner`."""
+        if self.gen_runner is None:
+            self.gen_runner = GenerationRunner(
+                self, cache_rungs=cache_rungs, slots=slots,
+                prompt_rungs=prompt_rungs, prefill_rungs=prefill_rungs,
+                decode_rungs=decode_rungs)
+        return self.gen_runner
+
     def jit_cache_size(self) -> Optional[int]:
         """jax's own executable-cache entry count for the jitted forward
         (the jax._src pjit cache behind ``_cache_size``); None where the
@@ -502,3 +526,372 @@ class ModelRunner:
                 "dtype": str(self.dtype),
                 "mesh": self.mesh_shape,
                 "device_count": self.device_count}
+
+
+def batch_rungs(max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two batch rungs up to and including ``max_batch`` —
+    the default prefill/decode coalescing ladder."""
+    n = int(max_batch)
+    rungs = []
+    r = 1
+    while r < n:
+        rungs.append(r)
+        r *= 2
+    rungs.append(n)
+    return tuple(rungs)
+
+
+class GenerationRunner:
+    """The autoregressive generation compute plane (ISSUE 16): a
+    bucketed KV-cache pool + three jitted functions over the owning
+    :class:`ModelRunner`'s live params.
+
+    **Pool**: per cache rung ``C`` (power-of-two lengths), per attention
+    layer, one ``(slots + 1, C, heads, head_dim)`` device array for keys
+    and one for values.  A slot is one request's cache page; the extra
+    slot (index ``slots``) is SCRATCH — padded batch rows gather from
+    and scatter into it, so a pad row can never touch a real request's
+    page and every real row stays a pure function of its own page (the
+    per-decoded-token bit-exactness contract rides on this).  A request
+    whose fill reaches its rung migrates up one rung (a jitted prefix
+    copy); a finished request's slot returns to the free list
+    immediately.
+
+    **Executables** (all tick the owning runner's ``compiles`` counter,
+    so the serving gates' zero-recompile proof covers generation):
+
+      - prefill: one per (prefill batch rung x prompt seq rung) — runs
+        the full forward over the prompt bucket, scatters every
+        attention layer's k/v into the slots, returns each row's logits
+        at its LAST REAL position (``lengths - 1``);
+      - decode: one per (decode batch rung x cache rung) — gathers the
+        co-batched requests' pages, appends this step's k/v row at each
+        row's own depth ``t``, attends the length-1 query over
+        ``[0..t]``, scatters ONLY the new row back, returns (rows,
+        vocab) logits.  O(C) per token vs the re-prefill oracle's
+        O(S^2);
+      - migrate: one per adjacent cache-rung pair — prefix copy of one
+        slot's page into a fresh slot a rung up.
+
+    Single-device only (the serving mesh and generation compose later);
+    compute calls are serialized by the frontend's compute thread —
+    alloc/release/migrate bookkeeping is not locked, by that contract.
+
+    Sampling is the CALLER's (the scheduler samples on host — logits
+    must materialize per tick anyway to pick the next token), which
+    keeps this class a pure compute surface."""
+
+    def __init__(self, runner: ModelRunner, cache_rungs, slots: int,
+                 prompt_rungs, prefill_rungs=None, decode_rungs=None):
+        import jax
+        import jax.numpy as jnp
+
+        from znicz_tpu.attention import (CharEmbedding, MultiHeadAttention,
+                                         SeqAll2All)
+        from znicz_tpu.ops.linear import seq_linear
+
+        if runner.mesh is not None:
+            raise ValueError(
+                "generation serving is single-device for now (the "
+                "KV-cache pool does not shard); drop "
+                "root.common.serving.mesh for this replica")
+        self.runner = runner
+        tr = runner._trainer
+        forwards = runner.workflow.forwards
+        last = forwards[-1]
+        if not forwards or not isinstance(forwards[0], CharEmbedding):
+            raise ValueError(
+                "generation serving needs a CharEmbedding first unit "
+                "(token ids in, one position per token)")
+        if not isinstance(last, tr._seq_softmax_cls):
+            raise ValueError(
+                "generation serving needs a per-position softmax head "
+                "(SeqAll2AllSoftmax) as the last unit")
+        self._attn = []
+        for f in forwards[1:-1]:
+            if isinstance(f, MultiHeadAttention):
+                if not f.causal:
+                    raise ValueError(
+                        f"{f.name}: generation requires causal "
+                        f"attention (a KV cache IS the causal prefix)")
+                self._attn.append(f)
+            elif isinstance(f, (SeqAll2All, tr._dropout_cls)):
+                pass                       # position-wise / eval-identity
+            else:
+                raise ValueError(
+                    f"{f.name}: unit {type(f).__name__} has no decode "
+                    f"form — generation serves CharEmbedding + causal "
+                    f"MultiHeadAttention + SeqAll2All* stacks")
+        if not self._attn:
+            raise ValueError("generation serving needs at least one "
+                             "MultiHeadAttention unit (nothing to cache)")
+        self.max_len = int(forwards[0].max_len)
+        rungs = tuple(sorted({int(r) for r in cache_rungs}))
+        if not rungs or rungs[0] < 2:
+            raise ValueError(f"cache rungs must be >= 2, got {rungs}")
+        if rungs[-1] > self.max_len:
+            raise ValueError(
+                f"cache rung {rungs[-1]} exceeds the positional "
+                f"table's max_len={self.max_len}")
+        self.cache_rungs = rungs
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError("slot pool needs >= 1 slot per rung")
+        #: scratch slot index — pad rows' page; never allocated
+        self.scratch = self.slots
+        self.prompt_rungs = tuple(sorted({int(r) for r in prompt_rungs
+                                          if self._rung_for(int(r))}))
+        if not self.prompt_rungs:
+            raise ValueError(
+                f"no prompt rung fits the cache ladder {rungs}")
+        self.prefill_rungs = tuple(prefill_rungs) if prefill_rungs \
+            else batch_rungs(4)
+        self.decode_rungs = tuple(decode_rungs) if decode_rungs \
+            else batch_rungs(self.slots)
+        shapes = {f.name: (f.heads, f.head_dim) for f in self._attn}
+        #: the pool: {rung: {layer: (slots+1, rung, heads, dim)}} x (k, v)
+        # commit the fresh pages to an explicit device: every later pool
+        # array is a COMMITTED donated jit output, and an uncommitted
+        # first-call pool would leave one stale lowering per pool rung
+        # that jax silently re-lowers (cache growth without a retrace)
+        # the first time steady-state traffic replays that shape
+        dev = jax.local_devices()[0]
+        self.pk = {C: {n: jax.device_put(
+                           jnp.zeros((self.slots + 1, C, h, d),
+                                     jnp.float32), dev)
+                       for n, (h, d) in shapes.items()}
+                   for C in self.cache_rungs}
+        self.pv = {C: {n: jax.device_put(
+                           jnp.zeros((self.slots + 1, C, h, d),
+                                     jnp.float32), dev)
+                       for n, (h, d) in shapes.items()}
+                   for C in self.cache_rungs}
+        self._free = {C: list(range(self.slots)) for C in self.cache_rungs}
+        compiles = runner._m["compiles"]
+        seq_softmax = tr._seq_softmax_cls
+        dropout = tr._dropout_cls
+
+        def run_prefill(params, pk, pv, x, lengths, slot_idx):
+            compiles.inc()      # znicz: ignore[jit-purity] — trace tick
+            h = tr._decode(x)
+            rows = {}
+            for f in forwards:
+                p = params.get(f.name, {})
+                if isinstance(f, MultiHeadAttention):
+                    h, k_seg, v_seg = f.apply_prefill(p, h)
+                    rows[f.name] = (k_seg, v_seg)
+                elif f is last and isinstance(f, seq_softmax):
+                    h = seq_linear(h, p["weights"], p.get("bias"),
+                                   weights_transposed=f.weights_transposed)
+                elif isinstance(f, dropout):
+                    pass
+                else:
+                    h = f.apply(p, h)
+            b, s = x.shape[:2]
+            logits = h[jnp.arange(b), lengths - 1]
+            pk = {n: pk[n].at[slot_idx, :s].set(rows[n][0]) for n in pk}
+            pv = {n: pv[n].at[slot_idx, :s].set(rows[n][1]) for n in pv}
+            return logits, pk, pv
+
+        def run_decode(params, pk, pv, slot_idx, tokens, t):
+            compiles.inc()      # znicz: ignore[jit-purity] — trace tick
+            h = None
+            rows = {}
+            toks = tr._decode(tokens)
+            for f in forwards:
+                p = params.get(f.name, {})
+                if isinstance(f, CharEmbedding):
+                    h = f.apply_decode(p, toks, t)
+                elif isinstance(f, MultiHeadAttention):
+                    h, k_row, v_row = f.apply_decode(
+                        p, h, pk[f.name][slot_idx], pv[f.name][slot_idx],
+                        t)
+                    rows[f.name] = (k_row, v_row)
+                elif f is last and isinstance(f, seq_softmax):
+                    h = seq_linear(h, p["weights"], p.get("bias"),
+                                   weights_transposed=f.weights_transposed)
+                elif isinstance(f, dropout):
+                    pass
+                else:
+                    h = f.apply(p, h)
+            pk = {n: pk[n].at[slot_idx, t].set(rows[n][0]) for n in pk}
+            pv = {n: pv[n].at[slot_idx, t].set(rows[n][1]) for n in pv}
+            return h[:, 0], pk, pv
+
+        def run_migrate(pk_src, pv_src, pk_dst, pv_dst, src, dst):
+            compiles.inc()      # znicz: ignore[jit-purity] — trace tick
+            c = next(iter(pk_src.values())).shape[1]
+            pk_dst = {n: pk_dst[n].at[dst, :c].set(pk_src[n][src])
+                      for n in pk_dst}
+            pv_dst = {n: pv_dst[n].at[dst, :c].set(pv_src[n][src])
+                      for n in pv_dst}
+            return pk_dst, pv_dst
+
+        dn = runner.donate
+        self._prefill = jax.jit(run_prefill,
+                                donate_argnums=(1, 2) if dn else ())
+        self._decode = jax.jit(run_decode,
+                               donate_argnums=(1, 2) if dn else ())
+        self._migrate = jax.jit(run_migrate,
+                                donate_argnums=(2, 3) if dn else ())
+
+    # -- pool bookkeeping (compute-thread only) --------------------------------
+
+    def _rung_for(self, length: int) -> Optional[int]:
+        """Smallest cache rung holding ``length`` positions, or None
+        when the ladder tops out below it."""
+        for c in self.cache_rungs:
+            if c >= length:
+                return c
+        return None
+
+    def alloc(self, rung: int) -> Optional[int]:
+        """Claim a free slot on ``rung`` (None = rung exhausted; the
+        scheduler queues until a release)."""
+        free = self._free[rung]
+        return free.pop() if free else None
+
+    def release(self, rung: int, slot: int) -> None:
+        """Return a finished/failed request's slot immediately — the
+        continuous-batching lever: the next prefill can claim it this
+        very tick."""
+        self._free[rung].append(slot)
+
+    def slots_active(self) -> int:
+        return sum(self.slots - len(f) for f in self._free.values())
+
+    def occupancy(self) -> float:
+        """Active slots / total slots, the KV-pool pressure gauge."""
+        return self.slots_active() / float(self.slots
+                                           * len(self.cache_rungs))
+
+    # -- compute (compute-thread only) -----------------------------------------
+
+    def _batch_rung(self, rungs, n: int) -> int:
+        for r in rungs:
+            if r >= n:
+                return r
+        raise ValueError(f"batch of {n} exceeds top rung {rungs[-1]}"
+                         f" — the scheduler chunks above this")
+
+    def prefill_async(self, x: np.ndarray, lengths, rung: int, slot_ids
+                      ) -> Tuple[object, int]:
+        """Dispatch a prefill — fill ``slot_ids``' pages on cache rung
+        ``rung`` from prompt bucket ``x`` ((n, S) ids, right-padded;
+        ``lengths`` the real prompt lengths) — WITHOUT syncing the
+        last-real-position logits back: returns ((b, vocab) DEVICE
+        logits, snapshot generation).  Rows are padded up to a prefill
+        batch rung; pad rows run against the scratch slot.  The
+        scheduler dispatches the tick's prefill before fetching its
+        decode chunks, so prompt compute overlaps decode sampling."""
+        n, s = x.shape
+        b = self._batch_rung(self.prefill_rungs, n)
+        xb = np.zeros((b, s), self.runner.dtype)
+        xb[:n] = x
+        ln = np.ones((b,), np.int32)
+        ln[:n] = lengths
+        sl = np.full((b,), self.scratch, np.int32)
+        sl[:n] = slot_ids
+        self.runner._maybe_stall()
+        params, gen = self.runner._active
+        logits, pk, pv = self._prefill(params, self.pk[rung],
+                                       self.pv[rung], xb, ln, sl)
+        self.pk[rung], self.pv[rung] = pk, pv
+        return logits, gen
+
+    def prefill(self, x: np.ndarray, lengths, rung: int, slot_ids
+                ) -> Tuple[np.ndarray, int]:
+        """Synchronous :meth:`prefill_async`: ((n, vocab) host logits,
+        generation)."""
+        logits, gen = self.prefill_async(x, lengths, rung, slot_ids)
+        return np.asarray(logits)[:len(slot_ids)], gen
+
+    def decode_async(self, rung: int, slot_ids, tokens, ts
+                     ) -> Tuple[object, int]:
+        """Dispatch one decode chunk over co-batched requests sharing
+        cache rung ``rung`` — feed each row's ``tokens[i]`` at its own
+        depth ``ts[i]``, append k/v — WITHOUT syncing the logits back:
+        returns ((b, vocab) DEVICE logits — ``np.asarray`` then slice
+        ``[:n]`` to fetch — and the snapshot generation).  The
+        scheduler dispatches every cache-rung chunk of a tick before
+        fetching any, so chunk N's compute overlaps chunk N-1's
+        host-side sampling and reply shipping."""
+        n = len(slot_ids)
+        b = self._batch_rung(self.decode_rungs, n)
+        sl = np.full((b,), self.scratch, np.int32)
+        sl[:n] = slot_ids
+        tk = np.zeros((b,), self.runner.dtype)
+        tk[:n] = tokens
+        tt = np.zeros((b,), np.int32)
+        tt[:n] = ts
+        self.runner._maybe_stall()
+        params, gen = self.runner._active
+        logits, pk, pv = self._decode(params, self.pk[rung],
+                                      self.pv[rung], sl, tk, tt)
+        self.pk[rung], self.pv[rung] = pk, pv
+        return logits, gen
+
+    def decode(self, rung: int, slot_ids, tokens, ts
+               ) -> Tuple[np.ndarray, int]:
+        """Synchronous :meth:`decode_async`: ((n, vocab) host logits,
+        generation)."""
+        logits, gen = self.decode_async(rung, slot_ids, tokens, ts)
+        return np.asarray(logits)[:len(slot_ids)], gen
+
+    def migrate(self, src_rung: int, src_slot: int, dst_rung: int,
+                dst_slot: int) -> None:
+        """Prefix-copy one slot's page up a rung (the request outgrew
+        ``src_rung``).  Slot bookkeeping is the caller's."""
+        pk, pv = self._migrate(self.pk[src_rung], self.pv[src_rung],
+                               self.pk[dst_rung], self.pv[dst_rung],
+                               np.int32(src_slot), np.int32(dst_slot))
+        self.pk[dst_rung], self.pv[dst_rung] = pk, pv
+
+    # -- contract surface ------------------------------------------------------
+
+    def executables(self) -> int:
+        """The warmed generation executable count — the zero-recompile
+        gate's expected jit-cache contribution."""
+        return (len(self.prefill_rungs) * len(self.prompt_rungs)
+                + len(self.decode_rungs) * len(self.cache_rungs)
+                + max(0, len(self.cache_rungs) - 1))
+
+    def warmup(self) -> int:
+        """Compile the full generation executable family up front (all
+        batches against the scratch slot — no real page is touched);
+        returns the owning runner's total ``compiles`` afterwards."""
+        for b in self.prefill_rungs:
+            for s in self.prompt_rungs:
+                self.prefill(np.zeros((b, s), self.runner.dtype),
+                             np.ones(b, np.int32), self._rung_for(s),
+                             [self.scratch] * b)
+        for b in self.decode_rungs:
+            for c in self.cache_rungs:
+                self.decode(c, [self.scratch] * b, np.zeros(b, np.int64),
+                            np.zeros(b, np.int64))
+        for lo, hi in zip(self.cache_rungs, self.cache_rungs[1:]):
+            self.migrate(lo, self.scratch, hi, self.scratch)
+        return self.runner.compiles
+
+    def jit_cache_size(self) -> Optional[int]:
+        """Sum of jax's own cache entries across the three generation
+        jits (None where the jax version hides it) — after warmup this
+        equals :meth:`executables` and must stay put."""
+        try:
+            return int(self._prefill._cache_size()
+                       + self._decode._cache_size()
+                       + self._migrate._cache_size())
+        except Exception:           # pragma: no cover - jax-version dep
+            return None
+
+    def stats(self) -> Dict:
+        return {"cache_rungs": list(self.cache_rungs),
+                "prompt_rungs": list(self.prompt_rungs),
+                "prefill_rungs": list(self.prefill_rungs),
+                "decode_rungs": list(self.decode_rungs),
+                "slots_per_rung": self.slots,
+                "slots_total": self.slots * len(self.cache_rungs),
+                "slots_active": self.slots_active(),
+                "occupancy": self.occupancy(),
+                "executables": self.executables(),
+                "jit_cache_size": self.jit_cache_size()}

@@ -524,6 +524,28 @@ class WebStatus:
                             "<table border=1><tr><th>bucket</th>"
                             "<th>hits</th><th>pad_ratio</th></tr>"
                             f"{brows}</table>")
+                        gen = serving.get("generate")
+                        if gen:
+                            # the generation row (ISSUE 16): continuous-
+                            # batching health — decode cadence, KV-slot
+                            # occupancy, prefill/decode split, migrations
+                            serving_html += (
+                                f"<p>generation: active {gen['active']}, "
+                                f"pending {gen['pending']}, KV slots "
+                                f"{gen['slots_active']}/"
+                                f"{gen['slots_total']}, inter-token p50 "
+                                f"{gen['inter_token_p50_ms']} ms / p99 "
+                                f"{gen['inter_token_p99_ms']} ms; "
+                                f"tokens {gen['generated_tokens']} "
+                                f"(prefill {gen['prefill_batches']} "
+                                f"batches / {gen['prefill_tokens']} "
+                                f"tokens, decode {gen['decode_batches']} "
+                                f"ticks / {gen['decode_tokens']} tokens), "
+                                f"migrations {gen['migrations']}, "
+                                f"finished {gen['gen_finished']}, "
+                                f"truncated {gen['gen_truncated']}, "
+                                f"timed out {gen['gen_timed_out']}, "
+                                f"cache rungs {gen['cache_rungs']}</p>")
                     bal = snap.get("balancer")
                     if bal:
                         # the fleet panel (ISSUE 12): one row per
