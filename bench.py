@@ -2754,6 +2754,413 @@ TELEMETRY_MAX_ROUNDS = 6    # bounded interleaved best-of pairs
 TELEMETRY_GATE_PCT = 2.0    # enabled may cost at most this much
 
 
+#: --elastic protocol knobs (ISSUE 17): zero-cold-start elasticity.
+#: Two phases.  (A) The AOT executable cache on the FULL transformer
+#: serving family (scoring buckets + prefill/decode/migrate): a cold
+#: boot compiles + serializes every executable next to the snapshot, a
+#: fresh process LOADS the family — gates are the boot-to-/readyz
+#: ratio (cold >= ELASTIC_BOOT_RATIO_FLOOR x warm) and ZERO recompiles
+#: over a mixed infer+generate stream after the load.  (B) The
+#: autoscaling balancer riding a closed-loop traffic ramp plus seeded
+#: preemption of HALF the initial fleet: scale-up must land (cache-
+#: warm boot) within ELASTIC_SCALEUP_DEADLINE_S, goodput holds a band
+#: of the pre-chaos baseline, the ledger stays exactly-once, and the
+#: idle settle window drains the fleet back toward the quorum.  Phase
+#: B rides the thin MNIST fleet model (it measures COORDINATION, same
+#: reasoning as --fleet); phase A carries the compile-heavy family
+#: where the cache earns its keep.  Both bands are RELATIVE, per the
+#: standing cgroup-swing discipline.
+ELASTIC_SEED = 1702
+ELASTIC_BOOT_RATIO_FLOOR = 3.0  # cold boot >= 3x cache-warm boot
+ELASTIC_REPLICAS = 4            # initial fleet; chaos preempts half
+ELASTIC_MAX = 6                 # autoscale_max
+ELASTIC_MIN = 2                 # min_replicas quorum
+ELASTIC_BASE_QPS = 20.0         # open-loop baseline offered load
+ELASTIC_BASE_S = 6.0
+ELASTIC_CHAOS_S = 18.0          # ramp + preemption window
+ELASTIC_SETTLE_S = 25.0         # idle window: scale-down must fire
+ELASTIC_INFLIGHT = 64           # closed-loop ramp pressure
+ELASTIC_SCALEUP_DEADLINE_S = 40.0
+ELASTIC_GOODPUT_BAND = 0.5      # chaos goodput >= band x baseline
+ELASTIC_GEN_STREAM = ((3, 24), (5, 40), (12, 30), (8, 44), (14, 36))
+
+
+def elastic_main() -> None:
+    """``--elastic``: the zero-cold-start elasticity gates (ISSUE 17),
+    one JSON line; gates AFTER the line so a trip never destroys the
+    record."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.parallel.chaos import (FaultSchedule, FleetScaler,
+                                          ReplicaHarness,
+                                          SubtreePreempter)
+    from znicz_tpu.serving import (InferenceClient, InferenceServer,
+                                   ReplicaBalancer)
+    from znicz_tpu.serving import aot_cache
+
+    if not aot_cache.available():
+        raise SystemExit("this jax build cannot serialize executables "
+                         "— the AOT cache gate cannot run")
+    sys.setswitchinterval(1e-3)
+    tmp = tempfile.mkdtemp(prefix="znicz_elastic_")
+
+    # ---- phase A: the AOT cache on the full transformer family ----------
+    root.charlm.loader.update({"n_train": 64, "n_valid": 16,
+                               "seq_len": GEN_TRAIN_LEN})
+    root.charlm.model.update(dict(SEQ_MODEL))
+
+    from znicz_tpu.samples.charlm import CharLMWorkflow
+
+    def charlm_wf():
+        prng.reset(1013)        # bit-identical params every build
+        wf = CharLMWorkflow()
+        wf.initialize(device=None)
+        return wf
+
+    wf_a = charlm_wf()
+    wf_a.snapshotter.directory = os.path.join(tmp, "charlm")
+    path_a = wf_a.snapshotter.save("elastic_a")
+    root.common.serving.seq.rungs = list(GEN_SEQ_RUNGS)
+    root.common.serving.generate.update({
+        "enabled": True, "cache_rungs": list(GEN_CACHE_RUNGS),
+        "slots": GEN_SLOTS})
+    # dir="" -> the cache lands in aot_cache/ NEXT TO the snapshot
+    root.common.serving.aot_cache.update({"enabled": True, "dir": ""})
+    rng = np.random.default_rng(ELASTIC_SEED)
+    vocab = SEQ_MODEL["vocab"]
+
+    def prompt_of(length):
+        return rng.integers(1, vocab, size=length).astype(np.uint8)
+
+    def drive_mixed(cli):
+        """The mixed stream the zero-recompile proof rides: scoring
+        requests across the seq ladder + generations that cross the
+        cache-rung migration."""
+        for ln in (3, 10, 16, 40, 64, 7):
+            cli.infer(prompt_of(ln)[None])
+        for p_len, max_new in ELASTIC_GEN_STREAM:
+            rep = cli.generate(prompt_of(p_len),
+                               max_new_tokens=max_new)
+            assert len(rep["tokens"]) >= 1
+
+    boots = []
+    ref_y = None
+    probe = prompt_of(12)[None]     # ONE pinned probe — the parity
+    # gate scores the same bytes through both boots
+    for which in ("cold", "warm"):
+        wf = charlm_wf()
+        srv = InferenceServer(wf, snapshot=path_a,
+                              max_batch=GEN_MAX_BATCH,
+                              max_delay_ms=5.0,
+                              queue_bound=8 * GEN_MAX_BATCH).start()
+        cli = InferenceClient(srv.endpoint, timeout=120,
+                              breaker_failures=0)
+        y = cli.infer(probe)
+        if ref_y is None:
+            ref_y = y
+        parity = bool(np.array_equal(ref_y, y))
+        compiles_post_boot = srv.runner.compiles
+        drive_mixed(cli)
+        jit_total = (srv.runner.jit_cache_size() or 0) + \
+            (srv.gen_sched.gen.jit_cache_size() or 0)
+        boots.append({
+            "which": which,
+            "boot_to_ready_s": round(srv.boot_to_ready_s, 3),
+            "warm_report": srv.warm_report,
+            "parity_vs_cold": parity,
+            "recompiles_mixed_stream":
+                srv.runner.compiles - compiles_post_boot,
+            "jit_cache_after_stream": jit_total,
+            "aot": srv.runner._aot_cache.stats()})
+        cli.close()
+        srv.stop()
+    cold, warm = boots
+    boot_ratio = cold["boot_to_ready_s"] / max(
+        warm["boot_to_ready_s"], 1e-9)
+    # phase A config off before phase B's scoring-only fleet
+    root.common.serving.generate.enabled = False
+    root.common.serving.seq.rungs = None
+
+    # ---- phase B: the autoscaler rides a ramp + preemption --------------
+    fleet_dir = os.path.join(tmp, "fleet")
+    wf_f = _build_fleet_workflow()
+    wf_f.snapshotter.directory = fleet_dir
+    path_f = wf_f.snapshotter.save("elastic_fleet")
+    # prewarm the fleet family once so EVERY fleet boot below is
+    # cache-warm — the elasticity story depends on it
+    pre = InferenceServer(_build_fleet_workflow(), snapshot=path_f,
+                          max_batch=FLEET_MAX_BATCH).start()
+    fleet_cold_boot_s = pre.boot_to_ready_s
+    pre.stop()
+
+    balancer = ReplicaBalancer(
+        replica_ttl_s=1.2, failover_timeout_s=1.0, failover_tries=4,
+        hedge_floor_s=0.4, min_replicas=ELASTIC_MIN).start()
+
+    wfs = [_build_fleet_workflow() for _ in range(ELASTIC_REPLICAS)]
+    binds = ["tcp://127.0.0.1:*"] * ELASTIC_REPLICAS
+
+    def make_factory(i):
+        def make():
+            return InferenceServer(
+                wfs[i], bind=binds[i], snapshot=path_f,
+                max_batch=FLEET_MAX_BATCH, max_delay_ms=2.0,
+                queue_bound=64, announce=balancer.endpoint,
+                replica_id=f"r{i}")
+        return make
+
+    harnesses = [ReplicaHarness(make_factory(i))
+                 for i in range(ELASTIC_REPLICAS)]
+    for i, h in enumerate(harnesses):
+        h.start()
+        binds[i] = h.server.endpoint
+
+    class _SpawnedReplica:
+        """FleetScaler handle for one autoscaler-spawned replica."""
+
+        def __init__(self, i):
+            self.replica_id = f"s{i}"
+            self.server = None
+
+        def start(self):
+            self.server = InferenceServer(
+                _build_fleet_workflow(), snapshot=path_f,
+                max_batch=FLEET_MAX_BATCH, max_delay_ms=2.0,
+                queue_bound=64, announce=balancer.endpoint,
+                replica_id=self.replica_id).start()
+            return self
+
+        def kill(self):
+            if self.server is not None:
+                self.server.stop()
+
+    class _HarnessHandle:
+        """Retire adapter: a scale-down of an initial replica kills
+        its harness for good (settle-phase only — the preemption
+        schedule has already run by then)."""
+
+        def __init__(self, rid, harness):
+            self.replica_id = rid
+            self._h = harness
+
+        def kill(self):
+            self._h.kill()
+
+    scaler = FleetScaler(_SpawnedReplica)
+    for i, h in enumerate(harnesses):
+        scaler.adopt(_HarnessHandle(f"r{i}", h))
+
+    t0 = _time.perf_counter()
+    while balancer.ready_count() < ELASTIC_REPLICAS:
+        if _time.perf_counter() - t0 > 120:
+            raise SystemExit("elastic fleet never became ready")
+        _time.sleep(0.05)
+
+    cli = InferenceClient(balancer.endpoint, timeout=25.0,
+                          resend_after_s=60.0, breaker_failures=0)
+    x1 = rng.normal(0, 1, (1, 28 * 28)).astype(np.float32)
+    infer_rids = set()
+    answers: dict = {}
+    warm_seen: dict = {}            # replica_id -> (warm_source, boot_s)
+
+    def pump(wait=0.002):
+        for rep in cli.collect(wait):
+            rid = rep.get("req_id")
+            if rid not in infer_rids:
+                continue
+            if rid in answers:
+                raise SystemExit(f"req {rid} answered twice — "
+                                 f"exactly-once broken")
+            answers[rid] = bool(rep.get("ok"))
+
+    def note_members():
+        for row in balancer.stats()["replicas"]:
+            if row["warm_source"] is not None:
+                warm_seen[row["replica_id"]] = (row["warm_source"],
+                                                row["boot_s"])
+
+    def ok_count():
+        return sum(1 for ok in answers.values() if ok)
+
+    def drive_open(duration_s, qps):
+        n0 = ok_count()
+        t0 = _time.perf_counter()
+        i = 0
+        while _time.perf_counter() - t0 < duration_s:
+            now = _time.perf_counter() - t0
+            if now >= i / qps and cli.in_flight < 256:
+                infer_rids.add(cli.submit(x1))
+                i += 1
+            pump()
+        return ok_count() - n0, _time.perf_counter() - t0
+
+    def drain(budget_s=25.0):
+        t0 = _time.perf_counter()
+        while cli.in_flight and _time.perf_counter() - t0 < budget_s:
+            pump(0.02)
+
+    # B1: pre-chaos baseline (autoscaler not armed yet)
+    ok_base, el_base = drive_open(ELASTIC_BASE_S, ELASTIC_BASE_QPS)
+    drain()
+    goodput_base = ok_base / el_base
+    note_members()
+
+    # B2: arm the autoscaler, then ramp + preempt half the fleet
+    balancer.enable_autoscale(
+        scaler.spawn, scaler.retire, autoscale_max=ELASTIC_MAX,
+        autoscale_high_load=0.75, autoscale_low_load=0.05,
+        autoscale_up_after=2, autoscale_down_after=6,
+        autoscale_eval_s=0.25, autoscale_cooldown_s=1.5,
+        autoscale_drain_timeout_s=8.0,
+        autoscale_boot_deadline_s=ELASTIC_SCALEUP_DEADLINE_S)
+    preempters = [
+        SubtreePreempter(FaultSchedule(ELASTIC_SEED + 1),
+                         [("r0", harnesses[0].kill,
+                           harnesses[0].restart)],
+                         kill_s=(2.0, 4.0), down_s=(2.0, 3.0)),
+        SubtreePreempter(FaultSchedule(ELASTIC_SEED + 2),
+                         [("r1", harnesses[1].kill,
+                           harnesses[1].restart)],
+                         kill_s=(7.0, 9.0), down_s=(2.0, 3.0)),
+    ]
+    for p in preempters:
+        p.start()
+    t_ramp0 = _time.perf_counter()
+    scaled_ready_at = None
+    n0 = ok_count()
+    while _time.perf_counter() - t_ramp0 < ELASTIC_CHAOS_S:
+        while cli.in_flight < ELASTIC_INFLIGHT:
+            infer_rids.add(cli.submit(x1))
+        pump()
+        if scaled_ready_at is None:
+            for row in balancer.stats()["replicas"]:
+                if row["replica_id"].startswith("s") and row["ready"]:
+                    scaled_ready_at = _time.perf_counter() - t_ramp0
+        note_members()
+    el_chaos = _time.perf_counter() - t_ramp0
+    for p in preempters:
+        p.join(timeout=60)
+    drain()
+    ok_chaos = ok_count() - n0
+    goodput_chaos = ok_chaos / el_chaos
+    note_members()
+    scale_ups = balancer.scale_ups
+
+    # B3: idle settle — the low band must drain back down
+    t0 = _time.perf_counter()
+    while _time.perf_counter() - t0 < ELASTIC_SETTLE_S:
+        pump(0.05)
+        if balancer.scale_downs >= 1 and \
+                _time.perf_counter() - t0 > 5.0:
+            break
+    scale_downs = balancer.scale_downs
+    note_members()
+    unanswered = [r for r in infer_rids if r not in answers]
+    ledger = balancer.ledger()
+    members_final = balancer.member_count()
+    spawned_warm = {rid: ws for rid, ws in warm_seen.items()
+                    if rid.startswith("s")}
+
+    record = {
+        "metric": "elastic_boot_ratio",
+        "value": round(boot_ratio, 2),
+        "unit": "cold_boot_over_cache_warm_boot",
+        "ratio_floor": ELASTIC_BOOT_RATIO_FLOOR,
+        "cold": cold,
+        "warm": warm,
+        "family": (cold["warm_report"] or {}).get("expected"),
+        "fleet_cold_boot_s": round(fleet_cold_boot_s, 3),
+        "seed": ELASTIC_SEED,
+        "replicas": ELASTIC_REPLICAS,
+        "goodput_base": round(goodput_base, 2),
+        "goodput_chaos": round(goodput_chaos, 2),
+        "goodput_band": ELASTIC_GOODPUT_BAND,
+        "preemptions": sum(p.preemptions for p in preempters),
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+        "scaleup_ready_s": None if scaled_ready_at is None
+        else round(scaled_ready_at, 2),
+        "scaleup_deadline_s": ELASTIC_SCALEUP_DEADLINE_S,
+        "warm_sources": warm_seen,
+        "members_final": members_final,
+        "unanswered": len(unanswered),
+        "ledger": ledger,
+        "failovers": balancer.failovers,
+        "heals": balancer.heals,
+        "replicas_lost": balancer.replicas_lost,
+    }
+    print(json.dumps(record))
+    cli.close()
+    balancer.stop()
+    scaler.stop_all()
+    for h in harnesses:
+        h.kill()
+    root.common.serving.aot_cache.update({"enabled": False, "dir": ""})
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if boot_ratio < ELASTIC_BOOT_RATIO_FLOOR:
+        failures.append(
+            f"cache-warm boot only {boot_ratio:.2f}x faster than cold "
+            f"(floor {ELASTIC_BOOT_RATIO_FLOOR}x)")
+    for b in (cold, warm):
+        rep = b["warm_report"] or {}
+        if not rep.get("ok"):
+            failures.append(f"{b['which']} boot warm proof failed: "
+                            f"{rep}")
+        if b["recompiles_mixed_stream"]:
+            failures.append(
+                f"{b['recompiles_mixed_stream']} recompiles in the "
+                f"{b['which']} boot's mixed stream (must be 0)")
+        if b["jit_cache_after_stream"]:
+            failures.append(
+                f"{b['which']} boot: {b['jit_cache_after_stream']} "
+                f"implicit jit cache entries slipped past the AOT "
+                f"tables")
+        if not b["parity_vs_cold"]:
+            failures.append(f"{b['which']} boot answers diverged")
+    wrep = warm["warm_report"] or {}
+    if wrep.get("cache_hits") != wrep.get("expected"):
+        failures.append(f"warm boot did not load the whole family "
+                        f"from cache: {wrep}")
+    if scale_ups < 1:
+        failures.append("the ramp never triggered a scale-up")
+    if scaled_ready_at is None:
+        failures.append(
+            f"no autoscaled replica became ready within the "
+            f"{ELASTIC_CHAOS_S}s chaos window")
+    elif scaled_ready_at > ELASTIC_SCALEUP_DEADLINE_S:
+        failures.append(
+            f"scale-up took {scaled_ready_at:.1f}s > deadline "
+            f"{ELASTIC_SCALEUP_DEADLINE_S}s")
+    bad_warm = {rid: ws for rid, ws in spawned_warm.items()
+                if ws[0] != "cache_hit"}
+    if bad_warm:
+        failures.append(f"autoscaled replicas booted WITHOUT the "
+                        f"cache: {bad_warm}")
+    if goodput_chaos < ELASTIC_GOODPUT_BAND * goodput_base:
+        failures.append(
+            f"chaos goodput {goodput_chaos:.1f}/s < "
+            f"{ELASTIC_GOODPUT_BAND} x baseline {goodput_base:.1f}/s")
+    if sum(p.preemptions for p in preempters) < 2:
+        failures.append("the seeded schedule preempted fewer than "
+                        "half the initial fleet")
+    if scale_downs < 1:
+        failures.append("the idle settle never drained the grown "
+                        "fleet (no scale-down)")
+    if not ledger["balanced"] or ledger["in_flight"]:
+        failures.append(f"ledger leaked: {ledger}")
+    if unanswered:
+        failures.append(f"{len(unanswered)} acknowledged requests "
+                        f"never answered (no reply, no refusal)")
+    shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        raise SystemExit("elastic gates failed: " + "; ".join(failures))
+
+
 #: --ingest gate knobs: the injected decode delay is calibrated to the
 #: measured warm segment time (so the gate is structural, not an absolute
 #: speed bet this host's swinging cgroup share can lose), clamped to
@@ -3179,6 +3586,8 @@ if __name__ == "__main__":
         seq_main()
     elif "--generate" in args:
         generate_main()
+    elif "--elastic" in args:
+        elastic_main()
     elif "--stream" in args:
         stream_main()
     elif "--product" in args:

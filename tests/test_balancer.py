@@ -471,6 +471,114 @@ def test_real_replica_announces_and_serves_through_balancer():
         bal.stop()
 
 
+# -- autoscaler (ISSUE 17) -----------------------------------------------------
+
+
+def test_autoscaler_spawns_to_cap_and_drains_back_to_quorum():
+    """The elasticity control loop over scripted replicas: a forced
+    'high' band spawns through the FleetScaler up to ``autoscale_max``
+    (pending-spawn reservations stop over-spawn at the cap), then a
+    forced 'low' band drains-then-retires back down to — and never
+    below — the ``min_replicas`` quorum, with traffic served and the
+    ledger balanced throughout."""
+    from znicz_tpu.parallel.chaos import FleetScaler, ScriptedReplica
+
+    bal, reps = _fleet(2, bal_kwargs=dict(min_replicas=2))
+    scaler = FleetScaler(
+        lambda i: ScriptedReplica(bal.endpoint, f"s{i}"))
+    for r in reps:
+        scaler.adopt(r)
+    cli = _client(bal)
+    try:
+        # high_load < 0 forces every eval 'high' — a deterministic ramp
+        bal.enable_autoscale(
+            scaler.spawn, scaler.retire, autoscale_max=4,
+            autoscale_high_load=-1.0, autoscale_low_load=-2.0,
+            autoscale_up_after=2, autoscale_down_after=2,
+            autoscale_eval_s=0.05, autoscale_cooldown_s=0.05,
+            autoscale_drain_timeout_s=5.0)
+        t0 = time.time()
+        while bal.member_count() < 4:
+            assert time.time() - t0 < 15, "never scaled to the cap"
+            time.sleep(0.02)
+        assert bal.scale_ups >= 2
+        st = bal.stats()["autoscale"]
+        assert st["enabled"] and st["max"] == 4
+        # at the cap: no spawns pile up past it
+        time.sleep(0.3)
+        assert bal.member_count() == 4
+        assert scaler.counts["spawned"] == 2
+        for _ in range(8):
+            assert cli.result(cli.submit(X1))["lb"] is True
+        # force 'low': drain-then-retire to the quorum, not past it.
+        # Retired members are evicted immediately, but a last
+        # heartbeat can race the kill and re-add one briefly — the
+        # cooldown sits ABOVE the 1.0s replica TTL so even that
+        # corpse is gone before the next decision
+        bal.enable_autoscale(
+            scaler.spawn, scaler.retire, autoscale_max=4,
+            autoscale_high_load=1e9, autoscale_low_load=1e9,
+            autoscale_cooldown_s=1.5)
+        t0 = time.time()
+        while bal.member_count() > 2:
+            assert time.time() - t0 < 25, "never drained to quorum"
+            time.sleep(0.05)
+        time.sleep(0.5)
+        assert bal.member_count() == 2          # quorum floor holds
+        assert bal.scale_downs == 2
+        assert scaler.counts["retired"] == 2
+        assert not bal.stats()["autoscale"]["retiring"]
+        assert cli.result(cli.submit(X1))["lb"] is True
+        assert bal.ledger()["balanced"]
+    finally:
+        _teardown(bal, reps, cli)
+        scaler.stop_all()
+
+
+def test_scale_down_never_counts_a_healing_replica_as_capacity():
+    """The ISSUE 17 satellite bugfix, as a regression test: a replica
+    mid-heal is serving STALE params and about to swap — it must not
+    count as servable capacity, or an idle band retires the last
+    HEALTHY replica while the heal is still in flight.  With one of
+    two replicas healing, the scale-down gate sees ONE servable
+    replica and (min_replicas=1) refuses to act; the moment the heal
+    clears, the same band drains exactly one."""
+    from znicz_tpu.parallel.chaos import FleetScaler, ScriptedReplica
+
+    bal, reps = _fleet(2, bal_kwargs=dict(min_replicas=1))
+    scaler = FleetScaler(
+        lambda i: ScriptedReplica(bal.endpoint, f"s{i}"))
+    for r in reps:
+        scaler.adopt(r)
+    cli = _client(bal)
+    try:
+        with bal._lock:                 # r1 enters its heal window
+            bal._healing["r1"] = time.time()
+        bal.enable_autoscale(
+            scaler.spawn, scaler.retire,
+            autoscale_high_load=1e9, autoscale_low_load=1e9,
+            autoscale_down_after=1, autoscale_eval_s=0.05,
+            autoscale_cooldown_s=0.2)
+        time.sleep(0.6)                 # many idle 'low' evals
+        assert bal.scale_downs == 0 and bal.member_count() == 2
+        st = bal.stats()
+        assert st["autoscale"]["servable"] == 1
+        rows = {r["replica_id"]: r for r in st["replicas"]}
+        assert rows["r1"]["healing"] and not rows["r0"]["healing"]
+        with bal._lock:                 # heal lands: r1 back on fleet
+            bal._healing.pop("r1")
+        t0 = time.time()
+        while bal.member_count() > 1:
+            assert time.time() - t0 < 15, "never drained post-heal"
+            time.sleep(0.05)
+        assert bal.scale_downs == 1
+        assert cli.result(cli.submit(X1))["lb"] is True
+        assert bal.ledger()["balanced"]
+    finally:
+        _teardown(bal, reps, cli)
+        scaler.stop_all()
+
+
 # -- chaos soak (ISSUE 12 satellite) -------------------------------------------
 
 

@@ -276,6 +276,116 @@ def test_scheduler_refusals_and_deadline():
     assert g.slots_active() == 0
 
 
+# -- slot exhaustion + pending-bound flood (ISSUE 17 satellite) ----------------
+
+
+def test_scheduler_slot_exhaustion_flood_no_leaks():
+    """A flood against ONE KV slot per rung plus a tight pending
+    bound: overflow submits are refused with the ``shed`` policy
+    (never queued, never holding a slot), everything admitted
+    finishes, a deadline expiry mid-generation ships its ``deadline``
+    partial AND releases its slot, and the pool comes back whole —
+    free lists full and duplicate-free."""
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16), slots=1, prompt_rungs=(8,))
+    sched = GenerationScheduler(g, max_new_cap=8, pending_bound=3)
+    refused0 = sched._m["gen_refused"].value
+    rng = np.random.default_rng(23)
+
+    def seq(rid, max_new=2):
+        return GenSeq(rng.integers(1, VOCAB, size=3).astype(np.uint8),
+                      max_new, req_id=rid)
+
+    for rid in (1, 2, 3):
+        assert sched.submit(seq(rid)) is None
+    ref = sched.submit(seq(4))               # 4th: queue at bound
+    assert ref is not None and ref.policy == "shed"
+    assert "generation queue at bound" in ref
+    assert sched._m["gen_refused"].value == refused0 + 1
+    # the flood drains: with one slot the three admitted generations
+    # serialize through the pool, and all of them finish ok
+    finals = {r["req_id"]: r for _, r in _run_to_completion(sched)
+              if not r.get("partial")}
+    assert set(finals) == {1, 2, 3}
+    assert all(r["ok"] and len(r["tokens"]) == 2
+               for r in finals.values())
+    assert g.slots_active() == 0
+
+    # deadline expiry WHILE holding a slot: the partial ships with the
+    # 'deadline' policy and the slot returns to the pool
+    a, b = seq(10, max_new=6), seq(11, max_new=6)
+    assert sched.submit(a) is None and sched.submit(b) is None
+    for _ in range(200):                     # drive until b owns a slot
+        sched.step()
+        if b.slot is not None:
+            break
+    assert b.slot is not None
+    b.t_deadline = 1e-9                      # absolute clock: expired
+    _, reps = sched.step()
+    timed = [r for _, r in reps if r.get("timed_out")]
+    assert len(timed) == 1 and timed[0]["req_id"] == 11
+    assert timed[0]["policy"] == "deadline"
+    _run_to_completion(sched)
+    assert g.slots_active() == 0
+    # the pool invariant the whole satellite rides: every slot is back
+    # exactly once, and scratch was never handed out
+    for rung, free in g._free.items():
+        assert sorted(free) == list(range(g.slots)), rung
+    # the queue is open again after the drain
+    assert sched.submit(seq(20)) is None
+    finals = {r["req_id"]: r for _, r in _run_to_completion(sched)
+              if not r.get("partial")}
+    assert finals[20]["ok"]
+    assert g.slots_active() == 0
+
+
+@pytest.mark.slow
+def test_scheduler_flood_soak_slots_never_leak():
+    """Churn soak: 60 mixed-size generations pushed through 2 slots
+    and a bound-8 queue, re-submitting every shed until admitted, a
+    third of them carrying tight deadlines.  Every admitted request
+    gets EXACTLY one terminal reply (final, truncated, or deadline
+    partial), and the pool ends whole."""
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
+                    prompt_rungs=(8,))
+    sched = GenerationScheduler(g, max_new_cap=24, pending_bound=8)
+    rng = np.random.default_rng(29)
+    todo = [GenSeq(rng.integers(1, VOCAB,
+                                size=int(rng.integers(2, 8))
+                                ).astype(np.uint8),
+                   int(rng.integers(1, 20)), req_id=1000 + i,
+                   deadline_s=(0.05 if i % 3 == 0 else None))
+            for i in range(60)]
+    terminal: dict = {}
+    sheds = 0
+    while todo or sched.work_available():
+        while todo:
+            ref = sched.submit(todo[0])
+            if ref is not None:
+                assert ref.policy == "shed"
+                sheds += 1
+                break                        # queue full — go step
+            todo.pop(0)
+        _, reps = sched.step()
+        for _, r in reps:
+            if r.get("partial"):
+                continue
+            assert r["req_id"] not in terminal, "duplicate terminal"
+            terminal[r["req_id"]] = r
+    assert len(terminal) == 60
+    assert sheds > 0                         # the bound actually bit
+    assert any(r.get("timed_out") for r in terminal.values())
+    assert any(r.get("ok") for r in terminal.values())
+    assert g.slots_active() == 0
+    for rung, free in g._free.items():
+        assert sorted(free) == list(range(g.slots)), rung
+
+
 # -- e2e service --------------------------------------------------------------
 
 

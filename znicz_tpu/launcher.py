@@ -29,7 +29,7 @@ import importlib.util
 import os
 import signal
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from znicz_tpu.core.config import apply_overrides, root
 from znicz_tpu.core.logger import setup_logging
@@ -175,6 +175,31 @@ class Launcher:
                             help="with --balance: static replica "
                                  "endpoints to pre-connect (membership "
                                  "still needs their heartbeats)")
+        parser.add_argument("--aot-cache", nargs="?", const="auto",
+                            default=None, metavar="DIR",
+                            help="with --serve: arm the AOT executable "
+                                 "cache (root.common.serving.aot_cache) "
+                                 "— warmed executables are serialized "
+                                 "next to the snapshot (or into DIR) "
+                                 "and a restarted replica LOADS its "
+                                 "family instead of compiling it "
+                                 "(zero-cold-start boots)")
+        parser.add_argument("--autoscale-max", type=int, default=None,
+                            metavar="N",
+                            help="with --balance and --spawn-cmd: arm "
+                                 "the autoscaler — spawn/retire replica "
+                                 "processes against the load band, "
+                                 "never past N replicas and never "
+                                 "below --min-replicas")
+        parser.add_argument("--spawn-cmd", default="", metavar="CMD",
+                            help="with --autoscale-max: shell command "
+                                 "that boots ONE replica announcing to "
+                                 "this balancer; '{announce}' and "
+                                 "'{replica_id}' are substituted (e.g. "
+                                 "\"python -m znicz_tpu mnist --serve "
+                                 "'tcp://127.0.0.1:*' --snapshot s.pkl.gz "
+                                 "--aot-cache --announce {announce} "
+                                 "--replica-id {replica_id}\")")
         parser.add_argument("--min-replicas", type=int, default=None,
                             metavar="N",
                             help="with --balance: readiness quorum "
@@ -208,6 +233,10 @@ class Launcher:
         if args.min_replicas is not None:
             root.common.serving.balance.min_replicas = \
                 int(args.min_replicas)
+        if args.aot_cache is not None:
+            root.common.serving.aot_cache.enabled = True
+            if args.aot_cache != "auto":
+                root.common.serving.aot_cache.dir = str(args.aot_cache)
         if args.mesh_data is not None:
             root.common.serving.mesh.data = int(args.mesh_data)
         if args.mesh_model is not None:
@@ -434,6 +463,47 @@ class Launcher:
                   else "none — awaiting --announce heartbeats")
         print(f"balancing at {balancer.endpoint} (static replicas: "
               f"{static}; quorum {balancer.min_replicas})", flush=True)
+        # autoscaler (ISSUE 17): spawn/retire replica PROCESSES via
+        # --spawn-cmd against the load band; retire only reaches
+        # processes this balancer spawned (the initial fleet is the
+        # operator's)
+        procs: Dict = {}
+        if args.autoscale_max is not None and args.spawn_cmd:
+            import shlex
+            import subprocess
+            import threading
+
+            seq = {"n": 0}
+            plock = threading.Lock()
+
+            def _spawn() -> None:
+                with plock:
+                    seq["n"] += 1
+                    rid = f"scale-{seq['n']}"
+                cmd = args.spawn_cmd.format(announce=balancer.endpoint,
+                                            replica_id=rid)
+                p = subprocess.Popen(shlex.split(cmd))
+                with plock:
+                    procs[rid] = p
+                print(f"autoscale: spawned {rid} (pid {p.pid})",
+                      flush=True)
+
+            def _retire(replica_id: str) -> None:
+                with plock:
+                    p = procs.pop(replica_id, None)
+                if p is None:
+                    print(f"autoscale: {replica_id} was not spawned "
+                          f"here — draining only, not killing",
+                          flush=True)
+                    return
+                p.terminate()
+                print(f"autoscale: retired {replica_id}", flush=True)
+
+            balancer.enable_autoscale(
+                _spawn, _retire,
+                autoscale_max=int(args.autoscale_max))
+            print(f"autoscaling up to {int(args.autoscale_max)} "
+                  f"replicas via: {args.spawn_cmd}", flush=True)
         try:
             while balancer.alive():
                 if balancer.max_requests is not None and \
@@ -447,6 +517,8 @@ class Launcher:
             pass
         finally:
             balancer.stop()
+            for p in procs.values():    # spawned replicas die with us
+                p.terminate()
             if status is not None:
                 status.stop()
         return 0

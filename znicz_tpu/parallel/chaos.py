@@ -704,6 +704,12 @@ class ScriptedReplica:
                     "draining": False, "swapping": False,
                     "gen": self.gen, "snapshot_path": self.path,
                     "queue_depth": 0, "served": self.served,
+                    # warm provenance (ISSUE 17): scripted replicas
+                    # have no executables — boot is instant by
+                    # construction, which is exactly what the fleet
+                    # autoscale tests want (zero-warmup fleets)
+                    "warm_source": "scripted", "warm_hits": 0,
+                    "warm_misses": 0, "boot_s": 0.0,
                     "p99_ms_by_bucket": {}}
 
     def _answer(self, req: Dict) -> Optional[Dict]:
@@ -809,6 +815,85 @@ class ScriptedReplica:
             loop.run(poll_ms=5)
         finally:
             loop.close()
+
+
+class FleetScaler:
+    """In-process spawn/retire driver for the balancer's autoscaler
+    (ISSUE 17): ``factory(i)`` builds a startable replica (a
+    :class:`ScriptedReplica`, or an ``InferenceServer`` factory like
+    :class:`ReplicaHarness` uses) for fleet index ``i``.  ``spawn()``
+    boots the next index on a daemon thread — the balancer calls it
+    outside its lock, but a model replica's warmup must not stall the
+    caller either — and ``retire(replica_id)`` kills the matching
+    handle.  Externally started replicas join via :meth:`adopt` so the
+    autoscaler can retire the INITIAL fleet too.  Tallies are read by
+    tests/bench after the dust settles."""
+
+    def __init__(self, factory):
+        import logging
+
+        self.factory = factory
+        self.log = logging.getLogger("znicz.chaos")
+        self._lock = threading.Lock()
+        self._handles: Dict[str, object] = {}
+        self._next = 0
+        self._n = {"spawned": 0, "retired": 0, "spawn_failures": 0}
+
+    def adopt(self, replica) -> None:
+        """Track an already-running replica (the pre-autoscale fleet)."""
+        with self._lock:
+            self._handles[replica.replica_id] = replica
+
+    def spawn(self) -> None:
+        with self._lock:
+            i = self._next
+            self._next += 1
+
+        def boot() -> None:
+            try:
+                rep = self.factory(i)
+                rep.start()
+                with self._lock:
+                    self._handles[rep.replica_id] = rep
+                    self._n["spawned"] += 1
+            except Exception:
+                with self._lock:
+                    self._n["spawn_failures"] += 1
+                self.log.exception("fleet scaler: spawn %d failed", i)
+
+        threading.Thread(target=boot, daemon=True,
+                         name=f"fleet-spawn-{i}").start()
+
+    def retire(self, replica_id: str) -> None:
+        with self._lock:
+            rep = self._handles.pop(replica_id, None)
+        if rep is None:
+            self.log.warning("fleet scaler: retire(%s) — no handle "
+                             "(already gone?)", replica_id)
+            return
+        rep.kill()
+        with self._lock:
+            self._n["retired"] += 1
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._handles)
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._n)
+
+    def stop_all(self) -> None:
+        """Teardown: kill every tracked replica."""
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for rep in handles:
+            try:
+                rep.kill()
+            except Exception:           # pragma: no cover - teardown race
+                pass
 
 
 # -- process-level kill harness ------------------------------------------------

@@ -155,6 +155,11 @@ class ReplicaBalancer:
         "parity_mismatches": "probes whose tensor frames differed",
         "replica_bad_frames": "replica-side bad-frame refusals "
                               "(unattributable; failover timer recovers)",
+        "scale_ups": "autoscaler spawn actions issued (ISSUE 17)",
+        "scale_downs": "autoscaler drain-then-retire actions completed",
+        "scale_drain_timeouts": "retiring replicas whose drain exceeded "
+                                "autoscale_drain_timeout_s (retired "
+                                "anyway; in-flight work fails over)",
     }
 
     def __init__(self, bind: str = "tcp://127.0.0.1:*",
@@ -199,6 +204,17 @@ class ReplicaBalancer:
         self._fleet_path: Optional[str] = None      # last promoted path
         self._healing: Dict[str, float] = {}        # replica -> t sent
         self._parity_buf: Dict[int, Dict] = {}      # probe_rid -> frames
+        # -- autoscaler (ISSUE 17; armed by enable_autoscale) — every
+        # field below is serve-thread-mutated under _lock like the
+        # membership state above
+        self._scaler: Optional[Dict] = None     # {"spawn", "retire"}
+        #: replica_id -> drain start: retired AFTER in-flight drains
+        self._retiring: Dict[str, float] = {}
+        #: spawn timestamps awaiting a NEW member announcement
+        self._scale_pending: List[float] = []
+        self._scale_known: set = set()      # member ids already seen
+        self._scale_streak = {"high": 0, "low": 0}
+        self._scale_last = {"action": 0.0, "eval": 0.0}
         self._rid = 0
         self._rr = 0                        # least-loaded tie-breaker
         self._stop = threading.Event()
@@ -271,8 +287,20 @@ class ReplicaBalancer:
                  "in_rotation": rid not in self._rotation_out(),
                  "device_count": m.get("device_count", 1),
                  "mesh": m.get("mesh"),
+                 "warm_source": m.get("warm_source"),
+                 "warm_hits": m.get("warm_hits", 0),
+                 "warm_misses": m.get("warm_misses", 0),
+                 "boot_s": m.get("boot_s"),
+                 "retiring": rid in self._retiring,
+                 "healing": rid in self._healing,
                  "p99_ms_by_bucket": dict(m["p99_ms_by_bucket"])}
                 for rid, m in sorted(self._members.items())]
+            autoscale = {"enabled": self._scaler is not None
+                         and bool(self.knobs["autoscale"]),
+                         "max": int(self.knobs["autoscale_max"]),
+                         "pending_spawns": len(self._scale_pending),
+                         "retiring": sorted(self._retiring),
+                         "servable": len(self._servable_ids())}
             roll = None
             if self._rollover is not None:
                 r = self._rollover
@@ -293,6 +321,7 @@ class ReplicaBalancer:
                < self.min_replicas,
                "static_replicas": list(self.static_replicas),
                "fleet_path": self._fleet_path,
+               "autoscale": autoscale,
                "rollover": roll,
                "rollover_history": history,
                "hedge_delay_ms": round(self._hedge_delay() * 1e3, 2),
@@ -405,6 +434,11 @@ class ReplicaBalancer:
                     self._tick_membership()
                     self._tick_inflight()
                     self._tick_rollover()
+                # OUTSIDE the hold above: the autoscaler computes its
+                # decisions under the lock but runs spawn/retire
+                # callbacks unlocked (a process spawn may block for
+                # seconds, and the ledger must keep ticking under it)
+                self._tick_autoscale()
 
             loop.add_tick(tick)
             self._ready.set()
@@ -525,6 +559,13 @@ class ReplicaBalancer:
                     skel.get("mesh"), dict) else None,
                 "p99_ms_by_bucket": dict(
                     skel.get("p99_ms_by_bucket") or {}),
+                # warmup provenance (ISSUE 17): the fleet panel's warm
+                # columns — where this replica's executables came from
+                # and how long its boot took
+                "warm_source": skel.get("warm_source"),
+                "warm_hits": int(skel.get("warm_hits") or 0),
+                "warm_misses": int(skel.get("warm_misses") or 0),
+                "boot_s": skel.get("boot_s"),
             }
             if prev is not None and prev["endpoint"] != endpoint:
                 # in-place endpoint change (wildcard-bind restart
@@ -577,7 +618,11 @@ class ReplicaBalancer:
         heal_gate = self._rollover is None \
             and self._fleet_path is not None
         for rid, m in self._members.items():
-            if not m["ready"] or rid in exclude or rid in rotation_out:
+            if not m["ready"] or rid in exclude or rid in rotation_out \
+                    or rid in self._retiring:
+                # retiring = drain-then-retire (ISSUE 17): its in-flight
+                # work finishes, but NEW work never lands on a replica
+                # the autoscaler is about to kill
                 continue
             load = (m["queue_depth"]
                     + self._dispatch_counts.get(rid, 0)) \
@@ -849,25 +894,36 @@ class ReplicaBalancer:
                 del self._ctrl[crid]
             dead = [rid for rid, m in self._members.items()
                     if now - m["last_seen"] > ttl]
-            if dead:
-                self._drop_unused_data_socks(
-                    {m["endpoint"] for r, m in self._members.items()
-                     if r not in dead})
             for rid in dead:
-                self._members.pop(rid)
-                self._healing.pop(rid, None)
                 self._m["replicas_lost"].inc()
-                self.log.warning("replica %s evicted (no heartbeat for "
-                                 ">%gs); failing over its in-flight "
-                                 "requests", rid, ttl)
-                for entry in list(self._inflight.values()):
-                    if entry.targets and entry.targets[-1] == rid:
-                        self._failover(entry, exclude={rid})
-                for probe in list(self._probes.values()):
-                    if probe.targets and probe.targets[-1] == rid:
-                        self._probes.pop(probe.rid)
-                        self._release(probe)
-                        self._parity_buf.pop(probe.rid, None)
+                self._evict_member(rid, f"no heartbeat for >{ttl}s")
+
+    def _evict_member(self, rid: str, why: str) -> None:
+        """Drop one member from the fleet NOW (lock held): fail over
+        its in-flight entries, clear its heal state, drop a parity
+        probe it was answering, prune its data socket when no other
+        member shares the endpoint.  Shared by TTL eviction and the
+        autoscaler's retire path — a deliberately retired replica must
+        not linger as phantom servable capacity until its TTL.  The
+        RLock re-enter costs nothing from the already-locked callers
+        and keeps the method safe to call bare (same idiom as
+        :meth:`_failover`)."""
+        with self._lock:
+            if self._members.pop(rid, None) is None:
+                return
+            self._healing.pop(rid, None)
+            self._drop_unused_data_socks(
+                {m["endpoint"] for m in self._members.values()})
+            self.log.warning("replica %s evicted (%s); failing over "
+                             "its in-flight requests", rid, why)
+            for entry in list(self._inflight.values()):
+                if entry.targets and entry.targets[-1] == rid:
+                    self._failover(entry, exclude={rid})
+            for probe in list(self._probes.values()):
+                if probe.targets and probe.targets[-1] == rid:
+                    self._probes.pop(probe.rid)
+                    self._release(probe)
+                    self._parity_buf.pop(probe.rid, None)
 
     def _failover(self, entry: _Entry, exclude=()) -> None:
         """Re-dispatch the SAME bytes to another replica, or refuse
@@ -942,6 +998,169 @@ class ReplicaBalancer:
                         continue
                     if not self._dispatch(entry):
                         self._parked.append(entry)
+
+    # -- autoscaler (ISSUE 17) -------------------------------------------------
+
+    def enable_autoscale(self, spawn, retire, **overrides) -> None:
+        """Arm the autoscaler: ``spawn()`` must start ONE new replica
+        process announcing to this balancer (the ``--serve --announce``
+        launcher path); ``retire(replica_id)`` must terminate one.
+        Both are invoked OUTSIDE the balancer lock — they may block on
+        process startup/teardown.  ``overrides`` land on the
+        ``autoscale_*`` knobs (tests/bench use fast cadences)."""
+        with self._lock:
+            self.knobs.update(overrides)
+            self.knobs["autoscale"] = True
+            self._scaler = {"spawn": spawn, "retire": retire}
+            self._scale_known = set(self._members)
+
+    def _servable_ids(self) -> List[str]:
+        """Members that carry REAL capacity right now (lock held):
+        ready, in rotation, not draining toward retirement, and NOT
+        mid-heal.  The heal exclusion is the ISSUE 17 satellite bugfix:
+        a replica inside its ``heal_backoff_s`` window is serving stale
+        params and about to swap — counting it as capacity let the
+        scale-down decision retire the last HEALTHY replica while the
+        heal was still in flight (regression test in
+        tests/test_balancer.py)."""
+        rotation_out = self._rotation_out()
+        return [rid for rid, m in self._members.items()
+                if m["ready"] and rid not in rotation_out
+                and rid not in self._retiring
+                and rid not in self._healing]
+
+    def _tick_autoscale(self) -> None:
+        """One autoscaler evaluation (serve tick cadence): reconcile
+        pending spawns with announcements, finish drains, and hold the
+        fleet inside the load band with hysteresis — scale-up after
+        ``autoscale_up_after`` consecutive high evals (parked requests
+        count as high: demand the fleet cannot even queue), drain-then-
+        retire after ``autoscale_down_after`` low evals, never below
+        the ``min_replicas`` quorum, one action per cooldown.
+        Decisions are computed under the lock; spawn/retire callbacks
+        run AFTER it is released."""
+        actions = []
+        with self._lock:
+            if self._scaler is None or not bool(self.knobs["autoscale"]):
+                return
+            now = time.perf_counter()
+            # 1. reconcile: a newly announced member consumes the
+            # oldest pending spawn; spawns past the boot deadline are
+            # forgotten (the process died before announcing — capacity
+            # accounting must not wedge on it)
+            fresh = set(self._members) - self._scale_known
+            for _ in fresh:
+                if self._scale_pending:
+                    self._scale_pending.pop(0)
+            self._scale_known = set(self._members)
+            boot_deadline = float(self.knobs["autoscale_boot_deadline_s"])
+            late = [t for t in self._scale_pending
+                    if now - t > boot_deadline]
+            if late:
+                self._scale_pending = [t for t in self._scale_pending
+                                       if now - t <= boot_deadline]
+                self.log.warning(
+                    "autoscale: %d spawned replica(s) never announced "
+                    "within %gs — abandoning the reservation(s)",
+                    len(late), boot_deadline)
+            # 2. finish drains: a retiring replica is killed once its
+            # in-flight work is gone (or the drain timeout spends —
+            # the failover ledger recovers whatever was left)
+            drain_timeout = float(self.knobs["autoscale_drain_timeout_s"])
+            for rid, t0 in list(self._retiring.items()):
+                m = self._members.get(rid)
+                drained = m is None or (
+                    self._dispatch_counts.get(rid, 0) == 0
+                    and m["queue_depth"] == 0)
+                if not drained and now - t0 > drain_timeout:
+                    self._m["scale_drain_timeouts"].inc()
+                    self.log.warning(
+                        "autoscale: %s drain exceeded %gs — retiring "
+                        "anyway (in-flight work fails over)", rid,
+                        drain_timeout)
+                    drained = True
+                if drained:
+                    del self._retiring[rid]
+                    self._m["scale_downs"].inc()
+                    self.log.info("autoscale: retiring %s", rid)
+                    actions.append(("retire", rid))
+                    # evict NOW, not at TTL: a retired corpse that
+                    # lingers as "ready" would count as servable
+                    # capacity and let the band retire healthy
+                    # replicas right past the quorum
+                    self._evict_member(rid, "autoscale retire")
+            # 3. band evaluation at its own (slower) cadence
+            if now - self._scale_last["eval"] \
+                    >= float(self.knobs["autoscale_eval_s"]):
+                self._scale_last["eval"] = now
+                servable = self._servable_ids()
+                if servable:
+                    load = sum(
+                        (self._members[r]["queue_depth"]
+                         + self._dispatch_counts.get(r, 0))
+                        / self._members[r].get("device_count", 1)
+                        for r in servable) / len(servable)
+                else:
+                    # zero servable capacity with work waiting is the
+                    # hardest possible "high"
+                    load = float("inf") if (self._parked
+                                            or self._inflight) else 0.0
+                high = bool(self._parked) \
+                    or load > float(self.knobs["autoscale_high_load"])
+                low = not self._parked and not high \
+                    and load < float(self.knobs["autoscale_low_load"])
+                self._scale_streak["high"] = \
+                    self._scale_streak["high"] + 1 if high else 0
+                self._scale_streak["low"] = \
+                    self._scale_streak["low"] + 1 if low else 0
+                cooling = now - self._scale_last["action"] \
+                    < float(self.knobs["autoscale_cooldown_s"])
+                total = len(self._members) + len(self._scale_pending)
+                if (self._scale_streak["high"]
+                        >= int(self.knobs["autoscale_up_after"])
+                        and not cooling
+                        and total < int(self.knobs["autoscale_max"])):
+                    self._scale_pending.append(now)
+                    self._scale_last["action"] = now
+                    self._scale_streak["high"] = 0
+                    self._m["scale_ups"].inc()
+                    self.log.info(
+                        "autoscale: scale-up (load %.2f, %d parked, "
+                        "%d members, %d pending)", load,
+                        len(self._parked), len(self._members),
+                        len(self._scale_pending))
+                    actions.append(("spawn", None))
+                elif (self._scale_streak["low"]
+                        >= int(self.knobs["autoscale_down_after"])
+                        and not cooling
+                        and not self._scale_pending
+                        and not self._retiring
+                        and len(servable) - 1 >= self.min_replicas):
+                    # scale-down only ABOVE quorum, and only from the
+                    # SERVABLE set (never a healing/retiring replica's
+                    # phantom capacity); drain first — _candidates
+                    # stops routing to it this instant
+                    victim = min(servable, key=lambda r: (
+                        self._members[r]["queue_depth"]
+                        + self._dispatch_counts.get(r, 0)))
+                    self._retiring[victim] = now
+                    self._scale_last["action"] = now
+                    self._scale_streak["low"] = 0
+                    self.log.info(
+                        "autoscale: scale-down — draining %s "
+                        "(load %.2f, %d servable)", victim, load,
+                        len(servable))
+        for kind, arg in actions:
+            # unlocked on purpose: process spawn/terminate may block,
+            # and the serve loop's ledger must keep ticking meanwhile
+            try:
+                if kind == "spawn":
+                    self._scaler["spawn"]()
+                else:
+                    self._scaler["retire"](arg)
+            except Exception:
+                self.log.exception("autoscale: %s callback failed "
+                                   "(%s)", kind, arg)
 
         # -- fleet-coordinated canary rollover -------------------------------------
 

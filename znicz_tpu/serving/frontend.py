@@ -88,6 +88,15 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             # over ``data``, wide FC tails column-shard over ``model``.
             # 1x1 = the single-device path, bit-exact
             "mesh": {"data": 1, "model": 1},
+            # AOT executable cache (ISSUE 17; serving/aot_cache.py): with
+            # enabled=True warmed executables are serialized into a
+            # content-addressed cache next to the snapshot (``dir``
+            # overrides the location) and a restarted replica LOADS its
+            # whole family instead of compiling it — the zero-cold-start
+            # lever bench.py --elastic gates (>= 3x faster boot-to-
+            # /readyz on this host).  Off by default: long-lived
+            # replicas pay nothing
+            "aot_cache": {"enabled": False, "dir": ""},
             "admission": {"enabled": True, "rate_limit": 0.0,
                           "rate_burst": 0.0, "fair": True, "quantum": 0,
                           "client_queue_bound": 0},
@@ -104,7 +113,29 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
                         "park_bound": 256, "canary_fraction": 0.34,
                         "canary_requests": 30, "canary_p99_mult": 3.0,
                         "canary_timeout_s": 30.0, "parity_every": 4,
-                        "heal_backoff_s": 30.0}}
+                        "heal_backoff_s": 30.0,
+                        # autoscaler (ISSUE 17; armed by ReplicaBalancer.
+                        # enable_autoscale): a control loop over the
+                        # per-replica capacity-weighted load — spawn when
+                        # the fleet-mean (queue_depth + in_flight)/
+                        # device_count sits above ``autoscale_high_load``
+                        # (or requests park) for ``autoscale_up_after``
+                        # consecutive evals, drain-then-retire the
+                        # least-loaded SERVABLE replica when below
+                        # ``autoscale_low_load`` for ``autoscale_down_
+                        # after`` evals — hysteresis both ways, one
+                        # action per ``autoscale_cooldown_s``, never
+                        # below the ``min_replicas`` quorum, never past
+                        # ``autoscale_max``
+                        "autoscale": False, "autoscale_max": 8,
+                        "autoscale_high_load": 4.0,
+                        "autoscale_low_load": 0.5,
+                        "autoscale_up_after": 2,
+                        "autoscale_down_after": 8,
+                        "autoscale_eval_s": 0.5,
+                        "autoscale_cooldown_s": 5.0,
+                        "autoscale_drain_timeout_s": 10.0,
+                        "autoscale_boot_deadline_s": 60.0}}
 
 
 def _cfg(name: str, override):
@@ -273,6 +304,17 @@ class InferenceServer:
                 replica_id=self.replica_id)
         self.max_requests = max_requests
         self._warmup = warmup
+        # AOT executable cache (ISSUE 17; read through a local alias
+        # like the admission subtree): resolved here, armed at serve()
+        # right before warmup so a bad directory fails start() readably
+        d_aot = DEFAULTS["aot_cache"]
+        aot = root.common.serving.aot_cache
+        self._aot_enabled = bool(aot.get("enabled", d_aot["enabled"]))
+        self._aot_dir = str(aot.get("dir", d_aot["dir"]) or "")
+        #: the boot-time warm proof (ModelRunner.warm_proof) recorded
+        #: once warmup finished — in AOT mode /readyz GATES on it
+        self.warm_report: Optional[Dict] = None
+        self.boot_to_ready_s: Optional[float] = None
         self.codec = wire.Codec(owner="serving")    # router-thread only
         # -- telemetry (ISSUE 5): serving counters + the request-latency
         # ring histogram live in the registry (component="serving");
@@ -282,6 +324,12 @@ class InferenceServer:
         _sc = telemetry.scope("serving")
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
+        # boot-to-/readyz distribution (ISSUE 17): cold compiles vs
+        # cache-warm loads land in visibly different buckets here —
+        # the fleet's elasticity latency on /metrics
+        self._m_boot = telemetry.scope("warmup").histogram(
+            "warmup_boot_to_ready_seconds",
+            "serve() entry -> /readyz true (warmup included)", size=64)
         self._m_latency = _sc.histogram(
             "request_latency_seconds",
             "e2e request latency (enqueue -> reply handoff)", size=8192)
@@ -382,6 +430,12 @@ class InferenceServer:
                 # 8-chip replica stop drawing equal traffic
                 "device_count": self.runner.device_count,
                 "mesh": self.runner.mesh_shape,
+                # warmup provenance (ISSUE 17): the fleet panel's warm
+                # columns + the autoscaler's boot visibility
+                "warm_source": self.runner.warm_source,
+                "warm_hits": int(self.runner._warm["hits"]),
+                "warm_misses": int(self.runner._warm["misses"]),
+                "boot_s": self.boot_to_ready_s,
                 "p99_ms_by_bucket": self.p99_ms_by_bucket()}
 
     def stats(self) -> Dict:
@@ -405,6 +459,8 @@ class InferenceServer:
         out["p99_ms_by_bucket"] = self.p99_ms_by_bucket()
         out["announce"] = self.announce
         out["heartbeats_out"] = self.heartbeats_out
+        out["boot_to_ready_s"] = self.boot_to_ready_s
+        out["warm_report"] = self.warm_report
         out["batcher"] = self.batcher.stats()
         out["model"] = self.runner.stats()
         if self.gen_sched is not None:
@@ -515,6 +571,7 @@ class InferenceServer:
     def _serve(self) -> None:
         from znicz_tpu.transport import TransportLoop
 
+        t_boot = time.perf_counter()    # boot-to-/readyz clock (ISSUE 17)
         loop = self._transport = TransportLoop(
             "serving", stop=self._stop, instance=self.replica_id)
         if self.transport_chaos is not None:
@@ -536,6 +593,13 @@ class InferenceServer:
             # ride the tick cadence, acks are drained and discarded
             hb = loop.connect_dealer(self.announce) if self.announce \
                 else None
+            if self._aot_enabled:
+                # arm the AOT executable cache (ISSUE 17) BEFORE any
+                # warmup dispatch: warmup then loads cached executables
+                # where they exist and serializes the ones it compiles.
+                # A jax build without serialize support degrades to
+                # plain compile-every-boot (enable returns False)
+                self.runner.enable_aot_cache(self._aot_dir)
             if self._warmup:
                 # compile every rung BEFORE taking traffic: first-
                 # request latency must not eat a compile, and the
@@ -550,6 +614,22 @@ class InferenceServer:
                 # rungs, decode x cache rungs, migrations) compile
                 # up-front too — the zero-recompile gate's baseline
                 self.gen_sched.gen.warmup()
+            if self._warmup:
+                # the strict warm-family proof (ISSUE 17, the PR-15
+                # jit-cache-equality discipline): in AOT mode /readyz
+                # must NOT flip true on a partially loaded family —
+                # raising here lands in _serve_error, so ready() stays
+                # False and start() surfaces the real cause
+                expected = len(self.batcher.ladder.buckets())
+                if self.gen_sched is not None:
+                    expected += self.gen_sched.gen.executables()
+                self.warm_report = self.runner.warm_proof(expected)
+                if self.runner.aot_enabled \
+                        and not self.warm_report["ok"]:
+                    raise RuntimeError(
+                        f"AOT warmup proof failed — refusing to flip "
+                        f"/readyz on a partial executable family: "
+                        f"{self.warm_report}")
             self.started_at = time.perf_counter()
             self._compute_thread = threading.Thread(
                 target=self._compute_loop, daemon=True,
@@ -579,6 +659,8 @@ class InferenceServer:
                 self._drain_outbound(sock)
 
             loop.add_tick(tick)
+            self.boot_to_ready_s = time.perf_counter() - t_boot
+            self._m_boot.observe(self.boot_to_ready_s)
             tick()                      # first heartbeat pre-poll
             self._ready.set()
             loop.run(poll_ms=5)

@@ -227,6 +227,18 @@ class ModelRunner:
         _sc.gauge("jit_cache_size", "jax's own executable-cache entries",
                   fn=telemetry.weak_fn(
                       self, lambda r: r.jit_cache_size()))
+        #: AOT dispatch table (ISSUE 17): {(shape, dtype): executable},
+        #: consulted BEFORE the jitted forward once enable_aot_cache
+        #: ran.  In AOT mode every executable enters the table by
+        #: deserialize or by explicit lower+compile — jax's own jit
+        #: call cache stays EMPTY, which is what makes the boot proof
+        #: strict: jit_cache_size() == 0 and table size == family size
+        #: means NOTHING was traced through the implicit path.
+        self._aot: Dict = {}
+        self._aot_cache = None          # ExecutableCache, or None
+        #: this runner's own warm tally (the cache's registry counters
+        #: are process-wide; proofs and heartbeats read these)
+        self._warm = {"hits": 0, "misses": 0}
 
     compiles = registered_property(
         "compiles", "traces of the jitted forward == jit cache entries")
@@ -358,7 +370,7 @@ class ModelRunner:
         callers must not reuse it after this call either way."""
         self._maybe_stall()
         params, gen = self._active
-        return self._fwd(params, x_dev), gen
+        return self._fwd_call(params, x_dev), gen
 
     def inject_compute_faults(self, schedule) -> None:
         """Arm the seeded compute-fault hook: ``schedule`` (a chaos
@@ -441,10 +453,13 @@ class ModelRunner:
                 params = self._place_params(
                     self._trainer.extract_params())
                 buckets = ladder.buckets() if ladder is not None else ()
+                # warm through _fwd_call: on an AOT-warm boot the jit
+                # call cache is EMPTY by design, and warming through
+                # self._fwd directly would recompile every rung
                 for bucket in buckets:
                     self._maybe_stall()
                     x = np.zeros(self.bucket_shape(bucket), self.dtype)
-                    np.asarray(self._fwd(params, self.stage(x)))
+                    np.asarray(self._fwd_call(params, self.stage(x)))
                 # retain the losing side for a disk-free rollback(); the
                 # hwm (not generation+1) allocates the new id, so a
                 # rolled-back-then-retried rollover never reuses a stamp
@@ -511,8 +526,137 @@ class ModelRunner:
         except Exception:               # pragma: no cover - jax-version dep
             return None
 
+    # -- AOT executable cache (ISSUE 17) ---------------------------------------
+
+    def enable_aot_cache(self, directory: str = "") -> bool:
+        """Arm the on-disk AOT executable cache (serving/aot_cache.py):
+        warmup and dispatch misses probe the cache before compiling,
+        and fresh compiles are serialized back.  ``directory`` defaults
+        to ``aot_cache/`` next to this runner's snapshot.  False (and
+        inert) when this jax build cannot serialize executables —
+        serving falls back to compile-every-boot, nothing breaks."""
+        from znicz_tpu.serving import aot_cache
+
+        if not aot_cache.available():
+            return False
+        if not directory:
+            if not self.snapshot_path:
+                raise ValueError(
+                    "enable_aot_cache needs an explicit directory when "
+                    "the runner was not booted from a snapshot")
+            directory = aot_cache.dir_for_snapshot(self.snapshot_path)
+        self._aot_cache = aot_cache.ExecutableCache(
+            directory, aot_cache.family_key(self))
+        return True
+
+    @property
+    def aot_enabled(self) -> bool:
+        return self._aot_cache is not None
+
+    def _aot_exec(self, table: Dict, key, entry: Dict, jitfn, args):
+        """AOT-mode dispatch for one executable: replay the table,
+        else deserialize from the cache (VALIDATED by executing it
+        where donation allows — a loaded executable that cannot run
+        this very call is refused and recompiled, never trusted), else
+        ``lower().compile()`` explicitly and serialize the result.
+        The explicit lower path traces (ticking ``compiles``) but
+        never touches jax's implicit jit call cache — the strictness
+        lever behind :meth:`warm_proof`.  Shared by the scoring
+        forward and the GenerationRunner's three jits (their tables
+        differ; the cache + accounting is the runner's)."""
+        fn = table.get(key)
+        if fn is not None:
+            return fn(*args)
+        cache = self._aot_cache
+        fn = cache.load(entry)
+        if fn is not None:
+            if self.donate:
+                # donated buffers would be consumed by a validation
+                # call; the content digest + key check already pin the
+                # aval signature, so trust the decode on this path
+                table[key] = fn
+                self._warm["hits"] += 1
+                cache.hit()
+                return fn(*args)
+            try:
+                out = fn(*args)
+            except Exception as exc:
+                cache.refuse(entry, exc)
+            else:
+                table[key] = fn
+                self._warm["hits"] += 1
+                cache.hit()
+                return out
+        compiled = jitfn.lower(*args).compile()
+        cache.store(entry, compiled)
+        table[key] = compiled
+        self._warm["misses"] += 1
+        cache.miss()
+        return compiled(*args)
+
+    def _fwd_call(self, params, x_dev):
+        """The forward dispatch every scoring path funnels through
+        (infer_staged AND swap's warm loop): plain jit call until
+        :meth:`enable_aot_cache`, the AOT table after."""
+        if self._aot_cache is None:
+            return self._fwd(params, x_dev)
+        key = (tuple(int(d) for d in x_dev.shape), str(x_dev.dtype))
+        entry = {"kind": "fwd", "shape": list(key[0]), "dtype": key[1]}
+        return self._aot_exec(self._aot, key, entry, self._fwd,
+                              (params, x_dev))
+
+    @property
+    def warm_source(self) -> Optional[str]:
+        """Where this boot's executables came from: ``cache_hit``
+        (all loaded), ``compiled`` (all traced), ``mixed``, or None
+        before any warmup — the per-replica heartbeat/panel label."""
+        h, m = self._warm["hits"], self._warm["misses"]
+        if h and m:
+            return "mixed"
+        if h:
+            return "cache_hit"
+        if m or self.compiles:
+            return "compiled"
+        return None
+
+    def warm_proof(self, expected: int) -> Dict:
+        """The strict warm-family proof /readyz gates on (ISSUE 17,
+        same discipline as PR 15's jit-cache equality): ``expected``
+        is the full executable family size (ladder buckets + the
+        generation family).  AOT mode proves ``loaded == expected``
+        AND jax's own jit caches are EMPTY (zero implicit traces
+        slipped past the tables); jit mode proves the PR-15 equality
+        ``compiles == expected == jit_cache_size``."""
+        gen = self.gen_runner
+        jit_total = self.jit_cache_size() or 0
+        if gen is not None:
+            jit_total += gen.jit_cache_size() or 0
+        if self.aot_enabled:
+            loaded = len(self._aot) + (len(gen._aot)
+                                       if gen is not None else 0)
+            ok = loaded == int(expected) and jit_total == 0
+            mode = "aot"
+        else:
+            loaded = jit_total
+            ok = self.compiles == int(expected) == jit_total
+            mode = "jit"
+        cache = self._aot_cache
+        return {"mode": mode, "expected": int(expected),
+                "loaded": int(loaded), "compiles": int(self.compiles),
+                "jit_cache_size": int(jit_total),
+                "cache_hits": int(self._warm["hits"]),
+                "cache_misses": int(self._warm["misses"]),
+                "cache_refusals": int(cache.counts["refusals"])
+                if cache is not None else 0,
+                "warm_source": self.warm_source, "ok": bool(ok)}
+
     def stats(self) -> Dict:
         return {"compiles": self.compiles,
+                "aot_enabled": self.aot_enabled,
+                "aot_loaded": len(self._aot),
+                "warm_source": self.warm_source,
+                "warm_hits": int(self._warm["hits"]),
+                "warm_misses": int(self._warm["misses"]),
                 "jit_cache_size": self.jit_cache_size(),
                 "generation": self.generation,
                 "swapping": self.swapping,
@@ -734,6 +878,11 @@ class GenerationRunner:
                                donate_argnums=(1, 2) if dn else ())
         self._migrate = jax.jit(run_migrate,
                                 donate_argnums=(2, 3) if dn else ())
+        #: AOT dispatch table (ISSUE 17), keyed ("prefill", b, s, rung)
+        #: / ("decode", b, rung) / ("migrate", src, dst) — the same
+        #: rungs warmup() walks, so a cache-warm boot loads the whole
+        #: generation family through the owning runner's _aot_exec
+        self._aot: Dict = {}
 
     # -- pool bookkeeping (compute-thread only) --------------------------------
 
@@ -774,6 +923,19 @@ class GenerationRunner:
         raise ValueError(f"batch of {n} exceeds top rung {rungs[-1]}"
                          f" — the scheduler chunks above this")
 
+    def _run_jit(self, key, jitfn, args):
+        """One generation dispatch: plain jit call until the owning
+        runner armed its AOT cache, the shared AOT table after.  The
+        key's ints are both the table key and the cache entry — the
+        rung grid is identical between warmup and traffic (prefill's
+        cache rung is ``_rung_for(prompt rung)`` on both sides), so
+        every traffic shape resolves to a warmed entry."""
+        r = self.runner
+        if r._aot_cache is None:
+            return jitfn(*args)
+        entry = {"kind": key[0], "key": [int(k) for k in key[1:]]}
+        return r._aot_exec(self._aot, key, entry, jitfn, args)
+
     def prefill_async(self, x: np.ndarray, lengths, rung: int, slot_ids
                       ) -> Tuple[object, int]:
         """Dispatch a prefill — fill ``slot_ids``' pages on cache rung
@@ -794,8 +956,9 @@ class GenerationRunner:
         sl[:n] = slot_ids
         self.runner._maybe_stall()
         params, gen = self.runner._active
-        logits, pk, pv = self._prefill(params, self.pk[rung],
-                                       self.pv[rung], xb, ln, sl)
+        logits, pk, pv = self._run_jit(
+            ("prefill", b, s, rung), self._prefill,
+            (params, self.pk[rung], self.pv[rung], xb, ln, sl))
         self.pk[rung], self.pv[rung] = pk, pv
         return logits, gen
 
@@ -826,8 +989,9 @@ class GenerationRunner:
         tt[:n] = ts
         self.runner._maybe_stall()
         params, gen = self.runner._active
-        logits, pk, pv = self._decode(params, self.pk[rung],
-                                      self.pv[rung], sl, tk, tt)
+        logits, pk, pv = self._run_jit(
+            ("decode", b, rung), self._decode,
+            (params, self.pk[rung], self.pv[rung], sl, tk, tt))
         self.pk[rung], self.pv[rung] = pk, pv
         return logits, gen
 
@@ -842,9 +1006,11 @@ class GenerationRunner:
                 dst_slot: int) -> None:
         """Prefix-copy one slot's page up a rung (the request outgrew
         ``src_rung``).  Slot bookkeeping is the caller's."""
-        pk, pv = self._migrate(self.pk[src_rung], self.pv[src_rung],
-                               self.pk[dst_rung], self.pv[dst_rung],
-                               np.int32(src_slot), np.int32(dst_slot))
+        pk, pv = self._run_jit(
+            ("migrate", src_rung, dst_rung), self._migrate,
+            (self.pk[src_rung], self.pv[src_rung],
+             self.pk[dst_rung], self.pv[dst_rung],
+             np.int32(src_slot), np.int32(dst_slot)))
         self.pk[dst_rung], self.pv[dst_rung] = pk, pv
 
     # -- contract surface ------------------------------------------------------
@@ -894,4 +1060,5 @@ class GenerationRunner:
                 "slots_active": self.slots_active(),
                 "occupancy": self.occupancy(),
                 "executables": self.executables(),
+                "aot_loaded": len(self._aot),
                 "jit_cache_size": self.jit_cache_size()}

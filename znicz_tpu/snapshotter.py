@@ -502,6 +502,24 @@ def write_host_pickle(path: str, snap: Dict, compression: str = "gz") -> None:
             os.remove(tmp)
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Atomic byte-blob write (temp + rename) for sidecar files written
+    next to snapshots — the AOT executable cache (serving/aot_cache.py)
+    uses this so a replica killed mid-store can never leave a truncated
+    entry for the next boot to refuse.  The temp name is pid-suffixed:
+    a whole FLEET of replicas may store the same cache entry
+    concurrently (same digest, same bytes), and two writers sharing one
+    temp path would race the rename against each other's unlink."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 _ORBAX_CKPTR = None
 
 

@@ -555,16 +555,39 @@ class WebStatus:
                         frows = "".join(
                             f"<tr><td>{html.escape(r['replica_id'])}"
                             f"{'' if r['in_rotation'] else ' (warming)'}"
+                            f"{' (retiring)' if r.get('retiring') else ''}"
+                            f"{' (healing)' if r.get('healing') else ''}"
                             f"</td><td>{'ready' if r['ready'] else 'NOT'}"
                             f"</td><td>{r['gen']}</td>"
                             # the mesh column (ISSUE 13): capacity-
                             # weighted dispatch divides load by this
                             f"<td>{html.escape('x'.join(str(v) for v in r['mesh'].values()) if r.get('mesh') else '1')}"
                             f" ({r.get('device_count', 1)}d)</td>"
+                            # warm provenance (ISSUE 17): where this
+                            # replica's executables came from + its
+                            # boot-to-ready — the elasticity columns
+                            f"<td>{html.escape(str(r.get('warm_source') or '-'))}"
+                            f" {r.get('warm_hits', 0)}/"
+                            f"{r.get('warm_misses', 0)}"
+                            f"{' (%.2fs boot)' % r['boot_s'] if isinstance(r.get('boot_s'), (int, float)) else ''}"
+                            f"</td>"
                             f"<td>{max(r['p99_ms_by_bucket'].values()) if r['p99_ms_by_bucket'] else '-'}"
                             f"</td><td>{r['in_flight']}</td>"
                             f"<td>{r['last_heartbeat_s']}s ago</td></tr>"
                             for r in bal["replicas"])
+                        asc = bal.get("autoscale") or {}
+                        asc_html = ""
+                        if asc.get("enabled"):
+                            # autoscale summary (ISSUE 17): band state
+                            # + lifetime action counts
+                            asc_html = (
+                                f"<p>autoscale: {asc['servable']} "
+                                f"servable (max {asc['max']}), pending "
+                                f"spawns {asc['pending_spawns']}, "
+                                f"retiring {asc['retiring']}, "
+                                f"scale-ups {bal.get('scale_ups', 0)}, "
+                                f"scale-downs "
+                                f"{bal.get('scale_downs', 0)}</p>")
                         roll = bal.get("rollover")
                         roll_html = ""
                         if roll:
@@ -595,9 +618,11 @@ class WebStatus:
                             f"{bal['rollovers']}, rollbacks: "
                             f"{bal['rollbacks']}, hedge delay: "
                             f"{bal['hedge_delay_ms']} ms</p>"
+                            f"{asc_html}"
                             f"{roll_html}"
                             "<table border=1><tr><th>replica</th>"
                             "<th>ready</th><th>gen</th><th>mesh</th>"
+                            "<th>warm (hit/miss)</th>"
                             "<th>p99 ms</th>"
                             "<th>in-flight</th><th>heartbeat</th></tr>"
                             f"{frows}</table>")
