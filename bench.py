@@ -2229,6 +2229,260 @@ def shard_main() -> None:
         raise SystemExit("shard gates failed: " + "; ".join(failures))
 
 
+#: --shard-train protocol knobs (ISSUE 18): the pod-sliced TRAINING
+#: gates, on the same 8 virtual CPU devices as --shard and with the
+#: same structure-not-throughput discipline.  One seeded single-slave
+#: MNIST fleet per scenario — the oracle (train_shard off), mesh 1x1
+#: under train_shard (must BE the single-device path, bit-exact), and
+#: the {data:4, model:2} pod slice — so the wire protocol, the job
+#: stream, and the Decision are identical across scenarios and every
+#: difference is attributable to the slice.  The model is the wide
+#: MNIST MLP (hidden >= tp_threshold) so the model axis engages the
+#: column-sharded layout; n_train/minibatch give 5 TRAIN minibatches
+#: per epoch, and segment_steps=4 pins the steady-state scan length so
+#: the post-run replay exercises exactly the executables the fleet
+#: compiled (k=4 segment + k=1 tail).  bytes-into-master is gated at
+#: <= 1% drift vs the oracle: the intra-slice psum tier is FREE on the
+#: wire — a sharded slave must not change what crosses the host
+#: boundary.  Convergence band reuses the --agg discipline (seeded
+#: async replicas; both runs must land converged, within a band of
+#: each other — the {4,2} run differs from the oracle only by XLA
+#: reduction-order noise amplified through training).
+SHARD_TRAIN_HIDDEN = 2048
+SHARD_TRAIN_EPOCHS = 3
+SHARD_TRAIN_N_TRAIN = 300
+SHARD_TRAIN_SEGMENT = 4
+SHARD_TRAIN_BASE_PORT = 18900
+SHARD_TRAIN_BYTES_DRIFT = 0.01
+
+
+def _shard_train_workflow(tag: str):
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.samples import mnist
+
+    prng.reset(1013)
+    root.mnist.loader.n_train = SHARD_TRAIN_N_TRAIN
+    root.mnist.loader.n_valid = 60
+    root.mnist.loader.minibatch_size = 60
+    root.mnist.decision.max_epochs = SHARD_TRAIN_EPOCHS
+    root.common.dirs.snapshots = f"/tmp/bench_shard_train/{tag}"
+    root.mnist.layers = [SHARD_TRAIN_HIDDEN, 10]
+    try:
+        wf = mnist.MnistWorkflow()
+    finally:
+        root.mnist.layers = [100, 10]
+    wf.initialize(device=None)
+    return wf
+
+
+def _shard_train_fleet(tag: str, port: int, dp: int, mp: int,
+                       shard: bool):
+    """One seeded single-slave fleet under the given engine-mesh
+    config; returns ``(server, master_wf, slave, err_pct)`` with the
+    slave's trainer still live for post-run inspection."""
+    import threading
+
+    from znicz_tpu.client import FusedClient
+    from znicz_tpu.core.config import root
+    from znicz_tpu.server import Server
+
+    root.common.engine.train_shard = bool(shard)
+    root.common.engine.mesh.data = int(dp)
+    root.common.engine.mesh.model = int(mp)
+    try:
+        ep = f"tcp://127.0.0.1:{port}"
+        wf = _shard_train_workflow(f"{tag}_m")
+        server = Server(wf, endpoint=ep, job_timeout=120.0,
+                        segment_steps=SHARD_TRAIN_SEGMENT)
+        slave = FusedClient(_shard_train_workflow(f"{tag}_s"),
+                            endpoint=ep, slave_id=f"{tag}w0")
+        errors: list = []
+
+        def worker():
+            try:
+                slave.run()
+            except BaseException as e:
+                errors.append((slave.slave_id, repr(e)))
+                raise
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        server.serve()
+        t.join(timeout=180)
+        if errors:
+            raise SystemExit(f"{tag}: slave crashed: {errors}")
+        if t.is_alive():
+            raise SystemExit(f"{tag}: slave hung")
+        dec = wf.decision
+        if not bool(dec.complete):
+            raise SystemExit(f"{tag}: training did not complete")
+        return server, wf, slave, float(dec.epoch_metrics[1]["err_pct"])
+    finally:
+        # the engine tree is process-global: leave it at the defaults
+        root.common.engine.train_shard = False
+        root.common.engine.mesh.data = 1
+        root.common.engine.mesh.model = 1
+
+
+def _shard_train_master_params(wf):
+    return {f.name: {k: np.asarray(a.map_read())
+                     for k, a in f.params().items()}
+            for f in wf.forwards if f.has_weights}
+
+
+def shard_train_main() -> None:
+    """``--shard-train``: the pod-sliced training gates (ISSUE 18),
+    one JSON line.  Three seeded single-slave fleets over the SAME
+    wire protocol and job stream:
+
+      - **oracle**: train_shard off — the single-device FusedClient;
+      - **mesh 1x1**: train_shard ON with a 1x1 mesh must resolve to
+        the single-device path — master's converged params
+        byte-identical to the oracle's, err_pct equal;
+      - **pod slice {data:4, model:2}**: shard shapes on the wide fc
+        layer (8 addressable shards, hidden/mp rows each — the
+        column-sharded layout, replicated over the data axis), the
+        slice shape visible on the master (register piggyback), the
+        SAME executable count as the oracle (explicit shardings add
+        zero recompiles), zero recompiles on a post-run replay of the
+        steady-state job shapes (k=4 segment + k=1 tail, numpy idx +
+        committed state — both warmed argument forms), bytes-into-
+        master within ``SHARD_TRAIN_BYTES_DRIFT`` of the oracle (the
+        ICI psum tier is free on the wire), and seeded convergence
+        inside the ``--agg``-style band.
+
+    Gates fire AFTER the JSON line so a trip never destroys the
+    measurement record."""
+    from znicz_tpu.virtdev import provision_cpu_devices
+
+    # BEFORE the first backend init (conftest discipline)
+    provision_cpu_devices(SHARD_DEVICES)
+
+    failures = []
+
+    # single-device oracle
+    srv_o, wf_o, sl_o, err_o = _shard_train_fleet(
+        "sto", SHARD_TRAIN_BASE_PORT, 1, 1, shard=False)
+    bytes_o = int(srv_o.bytes_in)
+    comp_o = int(sl_o._trainer._m_compiles.value)
+    if sl_o._trainer.mesh is not None:
+        failures.append("oracle slave grew a mesh with train_shard off")
+
+    # mesh 1x1 under train_shard: IS the single-device path, bit-exact
+    srv_1, wf_1, sl_1, err_1 = _shard_train_fleet(
+        "st1", SHARD_TRAIN_BASE_PORT + 1, 1, 1, shard=True)
+    if sl_1._trainer.mesh is not None:
+        failures.append("train_shard with a 1x1 mesh did not resolve "
+                        "to the single-device path")
+    p_o = _shard_train_master_params(wf_o)
+    p_1 = _shard_train_master_params(wf_1)
+    one_exact = (err_1 == err_o) and all(
+        np.array_equal(p_1[n][k], p_o[n][k])
+        for n in p_o for k in p_o[n])
+    if not one_exact:
+        failures.append("mesh 1x1 converged params differ from the "
+                        "single-device oracle (must be byte-identical)")
+
+    # the pod slice: {data:4, model:2}
+    srv_s, wf_s, sl_s, err_s = _shard_train_fleet(
+        "sts", SHARD_TRAIN_BASE_PORT + 2, 4, 2, shard=True)
+    t = sl_s._trainer
+    bytes_s = int(srv_s.bytes_in)
+    comp_s = int(t._m_compiles.value)
+    if t.mesh_shape != {"data": 4, "model": 2}:
+        failures.append(f"slave mesh {t.mesh_shape}, want "
+                        f"{{'data': 4, 'model': 2}}")
+    meshes_seen = list(srv_s.slave_meshes.values())
+    if meshes_seen != [{"data": 4, "model": 2}]:
+        failures.append(f"master saw slave meshes {meshes_seen} — the "
+                        f"register piggyback is broken")
+    # shard shapes: the wide fc layer is column-sharded over the model
+    # axis (hidden/mp rows per shard) and replicated over data
+    shard_rec = {}
+    for f in sl_s.workflow.forwards:
+        if not f.has_weights:
+            continue
+        for k, arr in f.params().items():
+            if arr.shape[0] != SHARD_TRAIN_HIDDEN:
+                continue
+            shards = [s.data.shape for s in
+                      arr.devmem.addressable_shards]
+            shard_rec[f"{f.name}.{k}"] = shards
+            want = SHARD_TRAIN_HIDDEN // 2
+            if (len(shards) != SHARD_DEVICES
+                    or any(s[0] != want for s in shards)):
+                failures.append(
+                    f"{f.name}.{k}: shards {shards}, want dim0={want} "
+                    f"on each of {SHARD_DEVICES} devices")
+    if not shard_rec:
+        failures.append(f"no param with dim0={SHARD_TRAIN_HIDDEN} "
+                        f"found — the model axis never engaged")
+    # jit hygiene: explicit shardings add ZERO executables vs the
+    # oracle, and a post-run replay of the steady-state job shapes
+    # (k=4 segment, k=1 tail; fresh numpy idx + committed state, the
+    # two warmed argument forms) recompiles nothing
+    if comp_s != comp_o:
+        failures.append(f"sharded slave compiled {comp_s} executables "
+                        f"vs oracle {comp_o} — sharding must not "
+                        f"change the executable count")
+    c0, j0 = int(t._m_compiles.value), dict(t.jit_cache_sizes())
+    rng = np.random.default_rng(7)
+    for k in (SHARD_TRAIN_SEGMENT, 1, SHARD_TRAIN_SEGMENT):
+        idx = rng.integers(0, SHARD_TRAIN_N_TRAIN, (k, 60))
+        mbs = [{"indices": idx[i].tolist(), "size": 60}
+               for i in range(k)]
+        sl_s._run_minibatch({"kind": "segment", "minibatches": mbs},
+                            train=True)
+    replay_recompiles = int(t._m_compiles.value) - c0
+    if replay_recompiles:
+        failures.append(f"{replay_recompiles} recompiles on the "
+                        f"steady-state replay after warmup")
+    if dict(t.jit_cache_sizes()) != j0:
+        failures.append(f"jax jit cache grew {j0} -> "
+                        f"{t.jit_cache_sizes()} on the replay")
+    # two-tier reduction: the intra-slice psum is free on the wire —
+    # bytes into the master must not drift
+    drift = abs(bytes_s - bytes_o) / max(bytes_o, 1)
+    if drift > SHARD_TRAIN_BYTES_DRIFT:
+        failures.append(f"bytes into master drifted {drift:.2%} "
+                        f"(oracle {bytes_o}, sharded {bytes_s}; "
+                        f"ceiling {SHARD_TRAIN_BYTES_DRIFT:.0%})")
+    # seeded convergence: the --agg discipline
+    if abs(err_s - err_o) > AGG_CONV_BAND:
+        failures.append(f"sharded err {err_s:.1f}% outside the band "
+                        f"(oracle {err_o:.1f}%, band {AGG_CONV_BAND})")
+    for tag, err in (("oracle", err_o), ("sharded", err_s)):
+        if err > AGG_ERR_CEIL:
+            failures.append(f"{tag} err {err:.1f}% > ceiling "
+                            f"{AGG_ERR_CEIL}% — did not converge")
+
+    print(json.dumps({
+        "metric": "train_sharded_structure",
+        "value": round(abs(err_s - err_o), 3),
+        "unit": "abs_err_pct_delta_vs_single_device_oracle",
+        "devices_provisioned": SHARD_DEVICES,
+        "hidden_width": SHARD_TRAIN_HIDDEN,
+        "mesh": {"data": 4, "model": 2},
+        "err_pct": {"oracle": err_o, "mesh_1x1": err_1,
+                    "sharded": err_s},
+        "mesh_1x1_byte_identical": bool(one_exact),
+        "bytes_into_master": {"oracle": bytes_o, "sharded": bytes_s,
+                              "drift": round(drift, 5),
+                              "ceiling": SHARD_TRAIN_BYTES_DRIFT},
+        "compiles": {"oracle": comp_o, "sharded": comp_s},
+        "replay_recompiles": replay_recompiles,
+        "jit_cache_sizes": dict(t.jit_cache_sizes()),
+        "shard_shapes": {k: [list(map(int, s)) for s in v]
+                         for k, v in shard_rec.items()},
+        "conv_band": AGG_CONV_BAND,
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    if failures:
+        raise SystemExit("shard-train gates failed: "
+                         + "; ".join(failures))
+
+
 #: --seq protocol knobs (ISSUE 15): the variable-length serving gates.
 #: The model is the charlm transformer widened so per-token COMPUTE
 #: dominates per-request overhead (the --serve lesson: a toy-thin model
@@ -3582,6 +3836,8 @@ if __name__ == "__main__":
         fleet_main()
     elif "--shard" in args:
         shard_main()
+    elif "--shard-train" in args:
+        shard_train_main()
     elif "--seq" in args:
         seq_main()
     elif "--generate" in args:

@@ -62,9 +62,30 @@ class MultiHeadAttention(ForwardBase):
         #: (unit engine AND fused path) needs no graph surgery
         self.residual = bool(residual)
         #: ("sp",) mesh when root.common.engine.seq_parallel is on
-        #: (built at initialize; apply shard_maps the core over it)
+        #: (built at initialize; apply shard_maps the core over it) — or
+        #: a TRAINER mesh via bind_sequence_mesh (ISSUE 18)
         self._sp_mesh = None
+        #: (batch axis or None, sequence axis) the shard_map splits over
+        self._sp_spec = (None, "sp")
         self.proj = {k: Array() for k in ("wq", "wk", "wv", "wo")}
+
+    def bind_sequence_mesh(self, mesh, batch_axis="data",
+                           seq_axis="model") -> bool:
+        """Ring attention on a TRAINER/SERVING mesh (ISSUE 18): instead
+        of a private ("sp",) mesh, shard_map the attention core over the
+        slice's own axes — batch over ``batch_axis``, sequence blocks
+        ring-rotating over ``seq_axis`` — so charlm training reuses the
+        very mesh its train steps are jitted over (no second device
+        grid, no resharding at the attention boundary).  Sticky:
+        ``initialize`` skips its private mesh once bound.  Returns False
+        (unbound) when the mesh lacks a >1 sequence axis."""
+        if mesh is None or seq_axis not in mesh.axis_names \
+                or int(mesh.shape[seq_axis]) < 2:
+            return False
+        self._sp_mesh = mesh
+        self._sp_spec = (batch_axis if batch_axis in mesh.axis_names
+                         else None, seq_axis)
+        return True
 
     def params(self) -> Dict[str, Array]:
         return dict(self.proj)
@@ -83,22 +104,25 @@ class MultiHeadAttention(ForwardBase):
         q = (x @ params["wq"]).reshape(b, t, h, d)
         k = (x @ params["wk"]).reshape(b, t, h, d)
         v = (x @ params["wv"]).reshape(b, t, h, d)
+        bax, sax = self._sp_spec
         if self.sp_axis:
             o = self._core(q, k, v, self.sp_axis)
-        elif self._sp_mesh is not None and t % self._sp_mesh.size == 0:
-            # the seq_parallel knob: ring attention over the ("sp",)
-            # mesh — q/k/v split along the sequence axis, k/v blocks
-            # rotate by ppermute, grads flow through the shard_map
-            # (tests/test_attention.py proves exactness + grad parity).
-            # A seq length the mesh cannot split (a short serving
-            # bucket) falls back to the dense core — same math.
+        elif (self._sp_mesh is not None
+                and t % self._sp_mesh.shape[sax] == 0
+                and (bax is None or b % self._sp_mesh.shape[bax] == 0)):
+            # ring attention over the bound mesh — q/k/v split along the
+            # sequence axis (and the batch axis when bound to a trainer
+            # mesh), k/v blocks rotate by ppermute, grads flow through
+            # the shard_map (tests/test_attention.py proves exactness +
+            # grad parity).  A shape the mesh cannot split (a short
+            # serving bucket) falls back to the dense core — same math.
             from jax.sharding import PartitionSpec as P
 
             from znicz_tpu.parallel.mesh import shard_map
 
-            spec = P(None, "sp")
+            spec = P(bax, sax)
             o = shard_map(
-                lambda q, k, v: self._core(q, k, v, "sp"),
+                lambda q, k, v: self._core(q, k, v, sax),
                 mesh=self._sp_mesh,
                 in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
         else:

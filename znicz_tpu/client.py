@@ -254,6 +254,14 @@ class Client:
         """The run's shared transport breaker (None before run())."""
         return self._breaker
 
+    @property
+    def mesh_shape(self):
+        """``{"data": dp, "model": mp}`` when this slave is a pod slice
+        (FusedClient on a training mesh), else None.  Piggybacked on the
+        register handshake so the master's web_status can show each
+        leaf's slice shape."""
+        return None
+
     def preempt(self) -> None:
         """Kill switch for the preemption chaos harness: the slave
         vanishes mid-whatever at its next loop iteration; the master's
@@ -527,8 +535,8 @@ class Client:
                     break               # simulated spot kill (ISSUE 11)
                 if not registered:
                     try:
-                        rep = self._rpc(ep,
-                                        handshake_request(self.workflow))
+                        rep = self._rpc(ep, handshake_request(
+                            self.workflow, mesh=self.mesh_shape))
                     except CircuitOpenError:
                         short_circuit()
                         continue
@@ -707,11 +715,19 @@ class FusedClient(Client):
         from znicz_tpu.parallel.fused import (FusedStagingUnsupportedError,
                                               FusedTrainer)
 
+        from znicz_tpu.parallel.mesh import train_mesh_from_config
+
         # construct EAGERLY so an unsupported graph (tied weights, ...)
         # raises FusedUnsupportedError here — where the launcher can fall
         # back to the unit Client — instead of crashing mid-fleet on the
-        # first job (compilation still happens lazily, per job shape)
-        self._trainer = FusedTrainer(workflow)
+        # first job (compilation still happens lazily, per job shape).
+        # With root.common.engine.train_shard on, THIS slave is a pod
+        # slice (ISSUE 18): steps jit with explicit shardings over the
+        # engine mesh, grads psum over ICI inside the slice, and the
+        # delta that leaves the process is already slice-summed — the
+        # wire sees exactly one slave either way
+        self._trainer = FusedTrainer(workflow,
+                                     mesh=train_mesh_from_config())
         if self._trainer.staging:
             # dedicated type: the engine's slave fallback catches exactly
             # the known refusals, so a real config error (a bare
@@ -729,17 +745,37 @@ class FusedClient(Client):
     def engine_name(self) -> str:
         return "fused"
 
+    @property
+    def mesh_shape(self):
+        """The trainer's slice shape (None single-device) — what the
+        register handshake piggybacks."""
+        return self._trainer.mesh_shape
+
     def _ensure_trainer(self):
         if self._scan is None:
             t = self._trainer
-            self._scan = t.make_train_scan()
-            self._eval = t.make_eval_step()
+            # registered on the trainer under its canonical names so
+            # jit_cache_sizes() (the zero-recompile cross-check) covers
+            # the slave's executables too
+            self._scan = t._train_scan = t.make_train_scan()
+            self._eval = t._eval_step = t.make_eval_step()
             loader = self.workflow.loader
             self._dataset = t._op_value(loader.original_data)
             self._targets = t._op_value(
                 loader.original_labels if t.loss_kind == "softmax"
                 else loader.original_targets)
             self._velocities = t.extract_velocities()
+            if t.mesh is not None:
+                # place operands to match the scan's declared shardings
+                # (committed single-device buffers would be refused by
+                # the explicit in_shardings): dataset/targets replicate
+                # once, velocities take their param placements
+                from znicz_tpu.parallel.mesh import global_put, replicated
+
+                repl = replicated(t.mesh)
+                self._dataset = global_put(self._dataset, repl)
+                self._targets = global_put(self._targets, repl)
+                self._velocities = t.place_state(self._velocities)
         return self._trainer
 
     def _run_minibatch(self, job: dict, train: bool):
@@ -748,7 +784,9 @@ class FusedClient(Client):
         k = len(mbs)
         idx = np.stack([np.asarray(mb["indices"], np.int32) for mb in mbs])
         bs = np.array([mb["size"] for mb in mbs], np.int32)
-        params = t.extract_params()     # master params, one H2D (synced)
+        # master params, one H2D (synced); on a mesh the put distributes
+        # each param straight to its slice placement
+        params = t.place_state(t.extract_params())
         if not train:
             assert k == 1
             loss, n_err, conf = self._eval(

@@ -246,6 +246,13 @@ ENGINE_DEFAULTS = {
     "seq_parallel": 0,            # ring-attention sp mesh size for
     #                               MultiHeadAttention (0/1 = off; the
     #                               single-device path, bit-exact)
+    # pod-sliced training (ISSUE 18): each slave/relay leaf a mesh slice
+    "train_shard": False,         # gate; OFF = single-device bit-exact
+    #                               whatever the mesh knobs say
+    "mesh": {                     # the training slice (train_shard on):
+        "data": 1,                # batch sharding over ICI (psum tier)
+        "model": 1,               # column-sharded wide FC weights
+    },
     # elastic async training (ISSUE 11)
     "min_slaves": 0,              # quorum gate; 0 = no gate
     "staleness_bound": 0,         # refuse deltas staler than this many

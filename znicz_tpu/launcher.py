@@ -135,19 +135,22 @@ class Launcher:
                                  "max_delay_ms/queue_bound)")
         parser.add_argument("--mesh-data", type=int, default=None,
                             metavar="N",
-                            help="with --serve: data-axis size of the "
-                                 "serving mesh (root.common.serving."
-                                 "mesh.data, default 1) — each request "
-                                 "batch splits into N row shards, one "
-                                 "per device (ISSUE 13).  With "
-                                 "--backend cpu, N x --mesh-model "
+                            help="data-axis size of the pod-slice mesh: "
+                                 "with --serve, root.common.serving."
+                                 "mesh.data (each request batch splits "
+                                 "into N row shards, ISSUE 13); with "
+                                 "--slave, root.common.engine.mesh.data "
+                                 "+ the train_shard gate (grads psum "
+                                 "over ICI inside the slice, ISSUE 18). "
+                                 "With --backend cpu, N x --mesh-model "
                                  "virtual devices are provisioned")
         parser.add_argument("--mesh-model", type=int, default=None,
                             metavar="N",
-                            help="with --serve: model-axis size of the "
-                                 "serving mesh (root.common.serving."
-                                 "mesh.model, default 1) — wide FC "
-                                 "layers column-shard over N devices")
+                            help="model-axis size of the pod-slice mesh "
+                                 "(serving.mesh.model with --serve, "
+                                 "engine.mesh.model with --slave) — "
+                                 "wide FC layers column-shard over N "
+                                 "devices")
         parser.add_argument("--announce", default=None,
                             metavar="BALANCER",
                             help="with --serve: heartbeat this replica "
@@ -237,10 +240,21 @@ class Launcher:
             root.common.serving.aot_cache.enabled = True
             if args.aot_cache != "auto":
                 root.common.serving.aot_cache.dir = str(args.aot_cache)
-        if args.mesh_data is not None:
-            root.common.serving.mesh.data = int(args.mesh_data)
-        if args.mesh_model is not None:
-            root.common.serving.mesh.model = int(args.mesh_model)
+        if args.mesh_data is not None or args.mesh_model is not None:
+            if args.slave is not None:
+                # a pod-sliced TRAINING leaf (ISSUE 18): the mesh flags
+                # target the engine tree and flip the train_shard gate
+                root.common.engine.train_shard = True
+                if args.mesh_data is not None:
+                    root.common.engine.mesh.data = int(args.mesh_data)
+                if args.mesh_model is not None:
+                    root.common.engine.mesh.model = int(args.mesh_model)
+            else:
+                if args.mesh_data is not None:
+                    root.common.serving.mesh.data = int(args.mesh_data)
+                if args.mesh_model is not None:
+                    root.common.serving.mesh.model = \
+                        int(args.mesh_model)
         if args.plan_tree is not None:
             return self._plan_tree(args)
         if args.balance is not None:
@@ -312,14 +326,20 @@ class Launcher:
             _load_module(args.config, "znicz_tpu._user_config")
         if args.overrides:
             apply_overrides(root, args.overrides)
-        # a serving mesh may also arrive via the config file or dotted
-        # overrides (not just the --mesh-* flags read above): now that
-        # both are applied, re-raise the CPU virtual-device count if
-        # the configured mesh needs more — still before the first jax
+        # a mesh may also arrive via the config file or dotted overrides
+        # (not just the --mesh-* flags read above): now that both are
+        # applied, re-raise the CPU virtual-device count if the
+        # configured mesh needs more — still before the first jax
         # backend init, and provision only ever raises the count
-        if args.backend == "cpu" and args.serve is not None:
-            mc = root.common.serving.mesh
-            need = int(mc.get("data", 1)) * int(mc.get("model", 1))
+        if args.backend == "cpu":
+            need = 1
+            if args.serve is not None:
+                mc = root.common.serving.mesh
+                need = int(mc.get("data", 1)) * int(mc.get("model", 1))
+            elif root.common.engine.get("train_shard", False):
+                # a pod-sliced training leaf (ISSUE 18)
+                mc = root.common.engine.mesh
+                need = int(mc.get("data", 1)) * int(mc.get("model", 1))
             if need > 1:
                 from znicz_tpu.virtdev import provision_cpu_devices
 

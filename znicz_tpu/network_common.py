@@ -141,10 +141,17 @@ def is_loopback_host(host: str) -> bool:
     return host in ("127.0.0.1", "localhost", "::1", "0.0.0.0")
 
 
-def handshake_request(workflow) -> dict:
-    """The slave's first message (the Client's ``register``)."""
-    return {"cmd": "register", "version": PROTOCOL_VERSION,
-            "workflow_digest": workflow_digest(workflow)}
+def handshake_request(workflow, mesh=None) -> dict:
+    """The slave's first message (the Client's ``register``).  ``mesh``
+    (``{"data": dp, "model": mp}``, pod-sliced slaves only) piggybacks
+    the leaf's slice shape for web_status; absent for single-device
+    slaves and ignored by older masters (check_handshake validates only
+    version + digest)."""
+    req = {"cmd": "register", "version": PROTOCOL_VERSION,
+           "workflow_digest": workflow_digest(workflow)}
+    if mesh:
+        req["mesh"] = dict(mesh)
+    return req
 
 
 def check_handshake(req: dict, workflow) -> Optional[str]:

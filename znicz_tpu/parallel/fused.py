@@ -88,6 +88,20 @@ class FusedTrainer:
         self.loader = workflow.loader
         self.decision = workflow.decision
         self.mesh = mesh
+        #: seq_parallel ring attention on the TRAINING mesh (ISSUE 18):
+        #: with the knob on and a >1 ``model`` axis in this slice, every
+        #: attention core shard_maps over THIS mesh (batch x sequence)
+        #: instead of building a private ("sp",) device grid — one mesh
+        #: serves the jitted steps AND the ring rotation
+        if mesh is not None and "model" in mesh.axis_names \
+                and mesh.shape["model"] > 1:
+            from znicz_tpu.attention import (MultiHeadAttention,
+                                             seq_parallel_size)
+
+            if seq_parallel_size() > 1:
+                for f in self.forwards:
+                    if isinstance(f, MultiHeadAttention):
+                        f.bind_sequence_mesh(mesh)
         self.loss_kind = ("softmax"
                           if isinstance(workflow.evaluator, EvaluatorSoftmax)
                           else "mse")
@@ -600,20 +614,61 @@ class FusedTrainer:
     def param_sharding(self, name, k, arr):
         """Per-param placement: wide (out, in) FC weights shard their output
         rows over the ``model`` axis (and the matching bias over ``model``);
-        everything else replicates.  XLA/GSPMD propagates the activation
-        shardings and inserts the collectives."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        everything else replicates.  The rule itself lives in the shared
+        placement home (``parallel.mesh.param_sharding``); this method keeps
+        the historical (name, k, arr) signature for serving/restore."""
+        from znicz_tpu.parallel.mesh import param_sharding
 
-        mesh = self.mesh
-        if ("model" in mesh.axis_names
-                and mesh.shape["model"] > 1
-                and int(arr.shape[0]) >= self.tp_threshold
-                and int(arr.shape[0]) % mesh.shape["model"] == 0):
-            if getattr(arr, "ndim", len(arr.shape)) == 2:
-                return NamedSharding(mesh, P("model", None))
-            if getattr(arr, "ndim", len(arr.shape)) == 1:
-                return NamedSharding(mesh, P("model"))
-        return NamedSharding(mesh, P())
+        return param_sharding(self.mesh, arr, self.tp_threshold)
+
+    @property
+    def mesh_shape(self):
+        """``{"data": dp, "model": mp}`` (None single-device) — the
+        heartbeat form, piggybacked on slave registration."""
+        from znicz_tpu.parallel.mesh import mesh_shape_dict
+
+        return mesh_shape_dict(self.mesh)
+
+    def place_state(self, tree):
+        """Distribute a params/velocities tree onto the mesh per the
+        shared ``param_sharding`` rule; identity when single-device (the
+        tree is already placed by extraction)."""
+        if self.mesh is None:
+            return tree
+        from znicz_tpu.parallel.mesh import place_tree
+
+        return place_tree(self.mesh, tree, self.tp_threshold)
+
+    def _state_shardings(self):
+        """(params tree shardings, velocities tree shardings, replicated)
+        for the live mesh — the explicit ``in_shardings``/``out_shardings``
+        every mesh-jitted step/scan declares.  Params replicate or
+        column-shard per ``param_sharding``; with the batch split over
+        ``data``, jax.grad's gradients demand replication, so GSPMD
+        inserts the ``lax.psum`` over the ``data`` axis INSIDE the
+        executable — the intra-slice (ICI) tier of the two-tier
+        reduction.  The host-side wire-v3 delta tier never sees it."""
+        from znicz_tpu.parallel.mesh import replicated, tree_shardings
+
+        psh = tree_shardings(
+            self.mesh,
+            {f.name: dict(f.params())
+             for f in self.forwards if f.has_weights},
+            self.tp_threshold)
+        vsh = tree_shardings(
+            self.mesh,
+            {f.name: dict(self.gd_of[f.name]._velocities)
+             for f in self.forwards
+             if f.has_weights and self.gd_of.get(f.name) is not None},
+            self.tp_threshold)
+        return psh, vsh, replicated(self.mesh)
+
+    def _jit_shardings(self, in_specs, out_specs):
+        """jax.jit kwargs: explicit shardings on a mesh, empty (the
+        byte-identical historical jit call) single-device."""
+        if self.mesh is None:
+            return {}
+        return {"in_shardings": in_specs, "out_shardings": out_specs}
 
     def _decode(self, data):
         """Storage decode IN-GRAPH: u8 data (HBM u8-residency or a
@@ -700,10 +755,19 @@ class FusedTrainer:
 
     def make_train_step(self):
         """The step takes ``hypers`` as a traced argument so per-epoch lr
-        adjustment (LearningRateAdjust) never recompiles."""
+        adjustment (LearningRateAdjust) never recompiles.  On a mesh the
+        jit declares explicit shardings (``_state_shardings``): params
+        pinned to their placements, batch operands replicated (the
+        in-step gather + constraint shard the minibatch over ``data``)."""
         import jax
 
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, vsh, repl = self._state_shardings()
+            kw = self._jit_shardings(
+                (psh, vsh, repl, repl, repl, repl, repl, repl),
+                (psh, vsh, repl))
 
         def step(params, velocities, hypers, dataset, targets, idx,
                  batch_size, key):
@@ -711,7 +775,7 @@ class FusedTrainer:
             return self._step_core(params, velocities, hypers, dataset,
                                    targets, idx, batch_size, key)
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1), **kw)
 
     def jit_cache_sizes(self) -> Dict[str, int]:
         """jax's own executable-cache entry counts for the live jitted
@@ -811,6 +875,12 @@ class FusedTrainer:
 
         nc = self._n_confusion()
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, vsh, repl = self._state_shardings()
+            kw = self._jit_shardings(
+                (psh, vsh, repl, repl, repl, repl, repl, repl, repl),
+                (psh, vsh, repl, repl))
 
         def chunk(params, velocities, hypers_mat, dataset, targets,
                   idx_mat, bs_vec, base_key, step_nums):
@@ -821,7 +891,7 @@ class FusedTrainer:
                 (idx_mat, bs_vec, step_nums, hypers_mat))
             return p, v, ms, conf_sum
 
-        return jax.jit(chunk, donate_argnums=(0, 1))
+        return jax.jit(chunk, donate_argnums=(0, 1), **kw)
 
     def make_eval_scan(self):
         """Metrics for K eval minibatches (TEST/VALID) in one dispatch —
@@ -833,8 +903,12 @@ class FusedTrainer:
 
         nc = self._n_confusion()
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, _, repl = self._state_shardings()
+            kw = self._jit_shardings((psh, repl, repl, repl, repl),
+                                     (repl, repl))
 
-        @jax.jit
         def chunk(params, dataset, targets, idx_mat, bs_vec):
             compiles.inc()
             conf_sum, ms = jax.lax.scan(
@@ -842,7 +916,7 @@ class FusedTrainer:
                 jnp.zeros((nc, nc), jnp.int32), (idx_mat, bs_vec))
             return ms, conf_sum
 
-        return chunk
+        return jax.jit(chunk, **kw)
 
     def make_eval_step(self):
         """Metrics-only step.  ``train`` is static: True replays the exact
@@ -851,11 +925,16 @@ class FusedTrainer:
         metrics BEFORE the update is adopted, matching the unit path where
         gd_skip gates the final update off once ``complete`` flips."""
         import jax
-        from functools import partial
 
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            # in_shardings entries cover the DYNAMIC args only (the
+            # static ``train`` flag is excluded)
+            psh, _, repl = self._state_shardings()
+            kw = self._jit_shardings((psh, repl, repl, repl, repl, repl),
+                                     repl)
 
-        @partial(jax.jit, static_argnums=(6,))
         def step(params, dataset, targets, idx, batch_size, key, train):
             compiles.inc()
             data = self._gather_decode(dataset, idx)
@@ -864,7 +943,7 @@ class FusedTrainer:
                 params, data, tgt, batch_size, key, train=train)
             return metrics
 
-        return step
+        return jax.jit(step, static_argnums=(6,), **kw)
 
     # -- the epoch driver ------------------------------------------------------
 
@@ -991,14 +1070,8 @@ class FusedTrainer:
         from znicz_tpu.parallel.mesh import global_put, replicated
 
         repl = replicated(self.mesh)
-        params = {name: {k: global_put(
-            a, self.param_sharding(name, k, a))
-            for k, a in layer.items()}
-            for name, layer in params.items()}
-        velocities = {name: {k: global_put(
-            a, self.param_sharding(name, k, a))
-            for k, a in layer.items()}
-            for name, layer in velocities.items()}
+        params = self.place_state(params)
+        velocities = self.place_state(velocities)
         if dataset is not None:
             dataset = global_put(dataset, repl)
             targets = global_put(targets, repl)
@@ -1018,8 +1091,6 @@ class FusedTrainer:
         reference's master/slave per-slave minibatch feed: no host ever
         touches another host's samples.  Dispatch is async either way, so
         segment N+1's assembly overlaps segment N's compute."""
-        import jax
-
         loader = self.loader
         idx_mat = np.stack([np.asarray(r, np.int32) for r in idx_rows])
         n_steps, batch = idx_mat.shape
@@ -1043,27 +1114,13 @@ class FusedTrainer:
             flat = idx_mat.reshape(-1)
             return (put(loader.host_gather(flat).reshape(shape_d)),
                     put(tgt_gather(flat).reshape(shape_t)))
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from znicz_tpu.parallel.mesh import (put_sharded_segment,
+                                             segment_sharding)
 
-        sh_d = NamedSharding(self.mesh, P(None, "data"))
-        sh_t = NamedSharding(self.mesh, P(None, "data"))
-        if jax.process_count() == 1:
-            flat = idx_mat.reshape(-1)
-            return (jax.device_put(
-                loader.host_gather(flat).reshape(shape_d), sh_d),
-                jax.device_put(tgt_gather(flat).reshape(shape_t), sh_t))
-
-        def cb(gather, index):
-            # index: per-shard slices over (step, batch, *sample); only
-            # the batch dim is sharded — gather exactly those rows
-            ks = range(*index[0].indices(n_steps))
-            rows = np.stack([gather(idx_mat[k, index[1]]) for k in ks])
-            return rows[(slice(None), slice(None)) + tuple(index[2:])]
-
-        return (jax.make_array_from_callback(
-                    shape_d, sh_d, lambda i: cb(loader.host_gather, i)),
-                jax.make_array_from_callback(
-                    shape_t, sh_t, lambda i: cb(tgt_gather, i)))
+        sh = segment_sharding(self.mesh)
+        return (put_sharded_segment(shape_d, sh, loader.host_gather,
+                                    idx_mat),
+                put_sharded_segment(shape_t, sh, tgt_gather, idx_mat))
 
     def _staging_donation(self) -> bool:
         """Donate the staged (K, B, ...) segment buffers into the direct
@@ -1096,6 +1153,17 @@ class FusedTrainer:
         nc = self._n_confusion()
         compiles = self._m_compiles
         donate = (0, 1, 3, 4) if self._staging_donation() else (0, 1)
+        kw = {}
+        if self.mesh is not None:
+            # staged segments keep whatever placement _stage_direct chose
+            # (batch-sharded, or the replicated fallback for batches the
+            # data axis doesn't divide) — None = infer from the operand,
+            # so BOTH placements hit the same executable family without
+            # a reshard
+            psh, vsh, repl = self._state_shardings()
+            kw = self._jit_shardings(
+                (psh, vsh, repl, None, None, repl, repl, repl),
+                (psh, vsh, repl, repl))
 
         def chunk(params, velocities, hypers_mat, data_seg, tgt_seg,
                   bs_vec, base_key, step_nums):
@@ -1106,7 +1174,7 @@ class FusedTrainer:
                 (data_seg, tgt_seg, bs_vec, step_nums, hypers_mat))
             return p, v, ms, conf_sum
 
-        return jax.jit(chunk, donate_argnums=donate)
+        return jax.jit(chunk, donate_argnums=donate, **kw)
 
     def make_eval_scan_direct(self):
         import jax
@@ -1114,8 +1182,12 @@ class FusedTrainer:
 
         nc = self._n_confusion()
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, _, repl = self._state_shardings()
+            kw = self._jit_shardings((psh, None, None, repl),
+                                     (repl, repl))
 
-        @jax.jit
         def chunk(params, data_seg, tgt_seg, bs_vec):
             compiles.inc()
 
@@ -1129,7 +1201,7 @@ class FusedTrainer:
                 (data_seg, tgt_seg, bs_vec))
             return ms, conf_sum
 
-        return chunk
+        return jax.jit(chunk, **kw)
 
     def make_train_step_direct(self):
         """Tail-update twin of ``make_train_step`` for staged (1, B, ...)
@@ -1139,6 +1211,12 @@ class FusedTrainer:
         import jax
 
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, vsh, repl = self._state_shardings()
+            kw = self._jit_shardings(
+                (psh, vsh, repl, None, None, repl, repl),
+                (psh, vsh, repl))
 
         def step(params, velocities, hypers, data_seg, tgt_seg,
                  batch_size, key):
@@ -1147,15 +1225,17 @@ class FusedTrainer:
                                      data_seg[0], tgt_seg[0], batch_size,
                                      key)
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1), **kw)
 
     def make_eval_step_direct(self):
         import jax
-        from functools import partial
 
         compiles = self._m_compiles
+        kw = {}
+        if self.mesh is not None:
+            psh, _, repl = self._state_shardings()
+            kw = self._jit_shardings((psh, None, None, repl, repl), repl)
 
-        @partial(jax.jit, static_argnums=(5,))
         def step(params, data_seg, tgt_seg, batch_size, key, train):
             compiles.inc()
             _, metrics = self.loss_and_metrics(
@@ -1163,7 +1243,7 @@ class FusedTrainer:
                 key, train=train)
             return metrics
 
-        return step
+        return jax.jit(step, static_argnums=(5,), **kw)
 
     def _advance_lr(self):
         if self._lr_adjust is not None:

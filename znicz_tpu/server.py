@@ -105,6 +105,10 @@ class Server:
         #: children that are aggregation-tree relays, not leaf slaves —
         #: the web_status topology panel marks them
         self.relays: set = set()
+        #: pod-sliced slaves (ISSUE 18): id -> {"data": dp, "model": mp}
+        #: piggybacked on the register handshake; single-device slaves
+        #: are absent — web_status shows each leaf's slice shape
+        self.slave_meshes: Dict[str, dict] = {}
         # -- telemetry (ISSUE 5): every master counter lives in the
         # process-wide registry (exported on /metrics) under
         # component="master"; the class-level _server_counter properties
@@ -394,6 +398,7 @@ class Server:
 
             self.dead_slaves[sid] = self.slaves.pop(sid)
             self.registered.discard(sid)
+            self.slave_meshes.pop(sid, None)
             if not bool(self.decision.complete):
                 # a member lost while training continues: a preemption
                 # the elastic mode rode out (ISSUE 11)
@@ -1039,6 +1044,13 @@ class Server:
                 self.relays.add(sid)
                 if req.get("bind"):
                     self.relay_binds[sid] = str(req["bind"])
+            mesh = req.get("mesh")
+            if isinstance(mesh, dict) and mesh:
+                # a pod-sliced leaf (ISSUE 18) advertised its slice shape
+                self.slave_meshes[sid] = {str(k): int(v)
+                                          for k, v in mesh.items()}
+            else:
+                self.slave_meshes.pop(sid, None)
             self.slaves[sid] = time.time()
             if req.get("relay") and newly_live:
                 # relay membership grew mid-run: re-plan (ISSUE 11)

@@ -71,26 +71,18 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from znicz_tpu.core.config import root
 from znicz_tpu.telemetry.metrics import registered_property
 
 
 def mesh_from_config():
-    """The serving mesh per ``root.common.serving.mesh.*`` (read
-    through a local alias so the config-knob lint tracks the keys), or
-    None for the default 1x1 — which keeps the runner on the exact
-    single-device code path (bit-for-bit today's behavior)."""
-    mc = root.common.serving.mesh
-    dp = int(mc.get("data", 1))
-    mp = int(mc.get("model", 1))
-    if dp < 1 or mp < 1:
-        raise ValueError(f"serving mesh axes must be >= 1, got "
-                         f"data={dp} model={mp}")
-    if dp * mp == 1:
-        return None
-    from znicz_tpu.parallel.mesh import make_mesh
+    """The serving mesh, or None for the default 1x1 — which keeps the
+    runner on the exact single-device code path (bit-for-bit the
+    pre-mesh behavior).  Kept under its historical name; the config
+    read and every other piece of placement machinery live in the ONE
+    shared home, ``parallel/mesh.py`` (ISSUE 18 extraction)."""
+    from znicz_tpu.parallel.mesh import serving_mesh_from_config
 
-    return make_mesh((dp, mp), ("data", "model"))
+    return serving_mesh_from_config()
 
 
 class ModelRunner:
@@ -281,32 +273,28 @@ class ModelRunner:
     def mesh_shape(self) -> Optional[Dict[str, int]]:
         """``{"data": dp, "model": mp}`` (None when single-device) —
         the heartbeat/panel form of the mesh."""
-        if self.mesh is None:
-            return None
-        return {str(a): int(self.mesh.shape[a])
-                for a in self.mesh.axis_names}
+        from znicz_tpu.parallel.mesh import mesh_shape_dict
+
+        return mesh_shape_dict(self.mesh)
 
     def _param_shardings(self, params):
-        """The params tree's NamedSharding tree: replicated, or
-        column-sharded over ``model`` where ``param_sharding`` applies
-        (wide FC weights).  Mesh-mode only."""
-        return {name: {k: self._trainer.param_sharding(name, k, a)
-                       for k, a in layer.items()}
-                for name, layer in params.items()}
+        """The params tree's NamedSharding tree per the shared
+        ``param_sharding`` rule (wide FC weights column-shard over
+        ``model``).  Mesh-mode only."""
+        from znicz_tpu.parallel.mesh import tree_shardings
+
+        return tree_shardings(self.mesh, params,
+                              self._trainer.tp_threshold)
 
     def _place_params(self, params):
         """Distribute a params tree onto the mesh per its shardings
-        (``global_put``: each process contributes only the shards it
-        owns — no device-0 round trip on multi-host).  Identity when
-        single-device: the tree is already placed by extraction."""
+        (the shared ``place_tree``).  Identity when single-device: the
+        tree is already placed by extraction."""
         if self.mesh is None:
             return params
-        from znicz_tpu.parallel.mesh import global_put
+        from znicz_tpu.parallel.mesh import place_tree
 
-        return {name: {k: global_put(
-            a, self._trainer.param_sharding(name, k, a))
-            for k, a in layer.items()}
-            for name, layer in params.items()}
+        return place_tree(self.mesh, params, self._trainer.tp_threshold)
 
     # -- the two halves of the ping-pong ---------------------------------------
 
@@ -330,12 +318,9 @@ class ModelRunner:
             x = np.ascontiguousarray(x, self.dtype)
         if self.mesh is None:
             return jax.device_put(x)
-        dp = self.data_parallel
-        if x.shape[0] % dp:
-            raise ValueError(
-                f"batch of {x.shape[0]} rows does not divide across "
-                f"the mesh's data axis (dp={dp}); pad to a ladder rung "
-                f"(rungs are snapped to multiples of dp)")
+        from znicz_tpu.parallel.mesh import require_batch_divisible
+
+        dp = require_batch_divisible(x.shape[0], self.mesh)
         if self._tracer.enabled:
             with self._tracer.span("model", "stage_sharded",
                                    rows=int(x.shape[0]), shards=dp,

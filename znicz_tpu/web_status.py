@@ -185,7 +185,10 @@ class WebStatus:
                      "last_seen_s": round(now - seen, 1),
                      # tree topology (ISSUE 10): direct children that
                      # are relays, not leaf slaves
-                     "relay": sid in srv.relays}
+                     "relay": sid in srv.relays,
+                     # pod-sliced leaves (ISSUE 18) advertise their
+                     # mesh shape on register; None = single-device
+                     "mesh": srv.slave_meshes.get(sid)}
                     for sid, seen in sorted(live.items())],
                 # leaf slaves working BEHIND relays: attributed in
                 # jobs_by_slave (contributor manifests) but never
@@ -390,7 +393,11 @@ class WebStatus:
                             f"<tr><td>{html.escape(s['id'])}"
                             f"{' (relay)' if s.get('relay') else ''}"
                             f"</td><td>{s['jobs']}</td>"
-                            f"<td>{s['last_seen_s']}s ago</td></tr>"
+                            f"<td>{s['last_seen_s']}s ago</td>"
+                            # pod-sliced leaves (ISSUE 18) show their
+                            # slice, e.g. "data=4 x model=2"
+                            f"<td>{'x'.join(f'{k}={v}' for k, v in s['mesh'].items()) if s.get('mesh') else 'single-device'}"
+                            "</td></tr>"
                             for s in master["slaves"])
                         master_html = (
                             f"<h2>Master {html.escape(master['endpoint'])}"
@@ -412,7 +419,8 @@ class WebStatus:
                             f"hits: {master['prefetch_hit']}</p>"
                             f"{elastic_html}"
                             "<table border=1><tr><th>slave</th><th>jobs"
-                            f"</th><th>last seen</th></tr>{srows}</table>"
+                            "</th><th>last seen</th><th>mesh</th></tr>"
+                            f"{srows}</table>"
                             f"<p>dead slaves: {len(master['dead_slaves'])}"
                             f", aggregated updates: "
                             f"{master.get('aggregated_updates', 0)}, "
