@@ -82,8 +82,19 @@ logits streamed back BIT-IDENTICAL across co-batched rounds of varying
 neighbor content, its tokens identical down to the solo run — each
 token a pure function of its own prompt), and the zero-recompile proof
 over the mixed prompt-length/generation-length stream (warmup compiles
-== scoring buckets + the prefill/decode/migrate executable families,
+== scoring buckets + the paged prefill/decode/copy executable family,
 nothing after).
+
+``python bench.py --prefix`` gates the paged-KV upgrades (ISSUE 19) in
+one JSON line: a seeded shared-system-prompt stream must prefill <=
+0.5x the prompt tokens of a prefix-cache-off run of the SAME stream
+with bit-exact decoded outputs between the two; a long-prompt barrage
+co-batched with paced decoders must hold the decoders' p99 inter-token
+latency within 1.5x of the no-barrage band (chunked prefill bounds the
+per-tick prefill work); on-device sampling must ship <= 1/64 of the
+logits path's per-tick reply bytes with bit-identical greedy tokens;
+and the whole mixed stream must recompile NOTHING, both jit caches
+gated by strict equality.
 
 ``python bench.py --serve`` gates the dynamic-batching inference service
 (znicz_tpu/serving/, ISSUE 4) in one JSON line: interleaved sequential-
@@ -2725,8 +2736,11 @@ def seq_main() -> None:
 GEN_MAX_BATCH = 8
 GEN_TRAIN_LEN = 64
 GEN_SEQ_RUNGS = (8, 16, 64)      # prompt ladder == scoring seq ladder
-GEN_CACHE_RUNGS = (32, 64)       # KV-cache length ladder
-GEN_SLOTS = 32                   # KV slots per cache rung
+GEN_PAGE_SIZE = 64               # KV page grain: coarse for the no-reuse path
+                                 # (one page covers the 64-token window; the
+                                 # --prefix bench runs the fine 16-token grain
+                                 # where sharing pays for the gather)
+GEN_SLOTS = 32                   # concurrent generations resident
 GEN_PROMPTS = (3, 5, 8, 12, 4, 14, 7, 9, 6, 10)      # mixed lengths
 GEN_MAX_NEW = (24, 40, 32, 48, 28, 36, 40, 44, 48, 32)  # mixed budgets
 GEN_INFLIGHT = 24                # concurrent generations offered
@@ -2736,7 +2750,7 @@ GEN_ROUNDS = 4                   # interleaved best-of rounds
 GEN_TPS_FLOOR = 10.0             # generation vs re-prefill oracle
 GEN_PARITY_ROUNDS = 4            # co-batched bit-exactness rounds
 GEN_PROBE_LEN = 6
-GEN_PROBE_NEW = 40               # fill crosses the 32->64 rung mid-run
+GEN_PROBE_NEW = 40               # fill crosses page boundaries mid-run
 
 
 def generate_main() -> None:
@@ -2759,11 +2773,11 @@ def generate_main() -> None:
         (and sampled continuations) vary — the probe's per-token
         logits must come back BIT-IDENTICAL every round (executables
         pinned by same-shape neighbors; each row's decode reads only
-        its own KV page), and its token stream must match the solo
-        run exactly (crossing a cache-rung migration mid-generation);
+        its own KV pages), and its token stream must match the solo
+        run exactly (crossing page-table rungs mid-generation);
       - zero recompiles: warmup compiles == scoring buckets + the
-        prefill x prompt-rung, decode x cache-rung and migrate
-        families, and NOTHING recompiles over the whole mixed stream.
+        paged prefill/decode x (batch rung, page rung) family + the
+        COW copy, and NOTHING recompiles over the whole mixed stream.
 
     Gates are enforced AFTER the JSON line so a tripped gate never
     destroys the measurement record."""
@@ -2789,7 +2803,7 @@ def generate_main() -> None:
 
     root.common.serving.seq.rungs = list(GEN_SEQ_RUNGS)
     root.common.serving.generate.update({
-        "enabled": True, "cache_rungs": list(GEN_CACHE_RUNGS),
+        "enabled": True, "page_size": GEN_PAGE_SIZE,
         "slots": GEN_SLOTS})
     srv = InferenceServer(wf, max_batch=GEN_MAX_BATCH, max_delay_ms=5.0,
                           queue_bound=8 * GEN_MAX_BATCH).start()
@@ -2947,7 +2961,9 @@ def generate_main() -> None:
         "oracle_token_p99_ms": oracle_p99_ms,
         "model": dict(SEQ_MODEL),
         "train_len": GEN_TRAIN_LEN,
-        "cache_rungs": list(GEN_CACHE_RUNGS),
+        "page_size": gstats["page_size"],
+        "num_pages": gstats["num_pages"],
+        "prefill_chunk": gstats["prefill_chunk"],
         "prompt_rungs": list(GEN_SEQ_RUNGS),
         "slots": GEN_SLOTS,
         "warm_compiles": warm_compiles,
@@ -2960,7 +2976,9 @@ def generate_main() -> None:
         "parity_tokens_pure": bool(tokens_pure),
         "parity_rounds": len(probe_logits),
         "parity_split_rounds_retried": split_rounds,
-        "migrations": gstats["migrations"],
+        "cow_copies": gstats["cow_copies"],
+        "prefix_hits": gstats["prefix_hits"],
+        "pages_leaked": gstats["pages_leaked"],
         "prefill_batches": gstats["prefill_batches"],
         "decode_batches": gstats["decode_batches"],
         "generated_tokens": gstats["generated_tokens"],
@@ -2981,6 +2999,9 @@ def generate_main() -> None:
     if recompiles:
         failures.append(f"{recompiles} recompiles during the mixed "
                         f"stream (must be 0)")
+    if gstats["pages_leaked"]:
+        failures.append(f"{gstats['pages_leaked']} KV pages leaked "
+                        f"(refcount invariant)")
     if jit_cache is not None and jit_cache != n_buckets:
         failures.append(f"scoring jit cache {jit_cache} != "
                         f"{n_buckets} buckets")
@@ -2996,6 +3017,238 @@ def generate_main() -> None:
                         "neighbors (purity contract)")
     if failures:
         raise SystemExit("generate gates failed: " + "; ".join(failures))
+
+
+#: --prefix protocol knobs (ISSUE 19): the paged-KV gates.  Sized to
+#: the --seq/--generate transformer (window GEN_TRAIN_LEN=64, page 16,
+#: chunk == page so prefix hits replay cold executables bit-exactly).
+PFX_SHARED = 48                  # shared system-prompt tokens (3 pages)
+PFX_STREAM = 10                  # shared-prefix requests per pass
+PFX_TAILS = (4, 6, 8, 5, 7, 4, 8, 6, 5, 7)   # unique tail lengths
+PFX_MAX_NEW = 4                  # greedy continuation per request
+PFX_RATIO_CEIL = 0.5             # on/off prefilled-token ratio gate
+PFX_STREAMERS = 4                # paced decoders in the latency phases
+PFX_STREAM_NEW = 56              # tokens per decoder (fills to window)
+PFX_TICK_MS = 40.0               # decode pacing (the band's metronome)
+PFX_BARRAGE_LEN = 60             # long-prompt barrage (4 chunks each)
+PFX_BARRAGE_INFLIGHT = 3         # barrage prompts resident
+PFX_P99_BAND = 1.5               # barrage p99 <= band x this
+PFX_BYTES_RATIO = 64             # logits-path bytes >= this x tokens-path
+
+
+def prefix_main() -> None:
+    """``--prefix``: the paged-KV gates (ISSUE 19), one JSON line.
+
+    Four phases, two boots of the same charlm server:
+
+      - prefill reduction: a seeded stream of PFX_STREAM prompts
+        sharing a PFX_SHARED-token system prefix (unique short tails)
+        runs against a prefix-cache-OFF boot (host sampling — also the
+        logits-bytes baseline) and then a prefix-ON boot; the ON run
+        must COMPUTE <= PFX_RATIO_CEIL x the prompt tokens the OFF run
+        computed, with every decoded stream bit-exact between the two
+        (chunk == page_size, so a hit replays the cold executables);
+      - chunked-prefill latency: PFX_STREAMERS paced decoders
+        (decode_tick_ms metronome) run once alone (the band) and once
+        against a barrage of unique PFX_BARRAGE_LEN-token prompts; the
+        decoders' client-stamped p99 inter-token gap under barrage
+        must stay within PFX_P99_BAND x the band — a long prompt costs
+        one bounded chunk per tick, never a whole-prompt stall;
+      - on-device sampling bytes: the ON boot ships (b,) tokens per
+        tick, the OFF boot (b, vocab) logits — fetched bytes per
+        emitted token must differ by >= PFX_BYTES_RATIO (the vocab-64
+        model's exact token/logits row ratio), greedy tokens already
+        proven bit-identical by phase 1;
+      - zero recompiles on the ON boot over everything above, both jit
+        caches gated by strict equality.
+
+    Gates are enforced AFTER the JSON line so a tripped gate never
+    destroys the measurement record."""
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.config import root
+    from znicz_tpu.serving import InferenceClient, InferenceServer
+
+    sys.setswitchinterval(1e-3)
+
+    prng.reset(1013)
+    root.charlm.loader.update({"n_train": 64, "n_valid": 16,
+                               "seq_len": GEN_TRAIN_LEN})
+    root.charlm.model.update(dict(SEQ_MODEL))
+
+    from znicz_tpu.samples.charlm import CharLMWorkflow
+
+    wf = CharLMWorkflow()
+    wf.initialize(device=None)
+    vocab = SEQ_MODEL["vocab"]
+    rng = np.random.default_rng(1013)
+    shared = rng.integers(1, vocab, size=PFX_SHARED).astype(np.uint8)
+    prompts = [np.concatenate(
+                   [shared, rng.integers(1, vocab, size=t
+                                         ).astype(np.uint8)])
+               for t in PFX_TAILS]
+
+    root.common.serving.seq.rungs = list(GEN_SEQ_RUNGS)
+
+    def boot(prefix_on):
+        root.common.serving.generate.update({
+            "enabled": True, "page_size": GEN_PAGE_SIZE,
+            "slots": 8, "prefix_cache": bool(prefix_on),
+            "on_device_sampling": bool(prefix_on),
+            "decode_tick_ms": PFX_TICK_MS if prefix_on else 0.0})
+        srv = InferenceServer(wf, max_batch=GEN_MAX_BATCH,
+                              max_delay_ms=5.0,
+                              queue_bound=8 * GEN_MAX_BATCH).start()
+        return srv, InferenceClient(srv.endpoint, timeout=120,
+                                    breaker_failures=0)
+
+    def shared_stream(srv, cli):
+        """The shared-prefix pass: serial greedy generations; returns
+        (token streams, prompt tokens computed, bytes fetched,
+        tokens emitted)."""
+        st0 = srv.gen_sched.stats()
+        toks = [cli.generate(p, PFX_MAX_NEW)["tokens"] for p in prompts]
+        st1 = srv.gen_sched.stats()
+        return (toks,
+                st1["prefill_tokens"] - st0["prefill_tokens"],
+                st1["fetch_bytes"] - st0["fetch_bytes"],
+                st1["generated_tokens"] - st0["generated_tokens"])
+
+    # ---- OFF boot: the baseline side of phases 1 and 3 -----------------------
+    srv, cli = boot(prefix_on=False)
+    toks_off, prefill_off, bytes_off, emitted_off = shared_stream(srv, cli)
+    cli.close()
+    srv.stop()
+
+    # ---- ON boot: everything else runs here ----------------------------------
+    srv, cli = boot(prefix_on=True)
+    warm_compiles = srv.runner.compiles
+    n_buckets = len(srv.batcher.ladder.buckets())
+    gen_execs = srv.gen_sched.gen.executables()
+    toks_on, prefill_on, bytes_on, emitted_on = shared_stream(srv, cli)
+    prefix_exact = all(np.array_equal(a, b)
+                       for a, b in zip(toks_off, toks_on))
+    prefill_ratio = prefill_on / max(prefill_off, 1)
+    gstats_mid = srv.gen_sched.stats()
+
+    def stream_phase(barrage):
+        """PFX_STREAMERS streaming decoders, client-stamped; with
+        ``barrage``, unique long prompts kept resident alongside.
+        Returns the decoders' p99 inter-token gap in ms."""
+        stamps = []
+        streamer_rids = []
+        for _ in range(PFX_STREAMERS):
+            p = rng.integers(1, vocab, size=4).astype(np.uint8)
+            s = []
+            stamps.append(s)
+            streamer_rids.append(cli.submit_generate(
+                p, PFX_STREAM_NEW, stream=True,
+                on_token=lambda tok, i, s=s:
+                    s.append(_time.perf_counter())))
+        pending = set(streamer_rids)
+        barrage_live = set()
+        barrage_done = 0
+        while pending:
+            if barrage:
+                while len(barrage_live) < PFX_BARRAGE_INFLIGHT:
+                    long_p = rng.integers(1, vocab, size=PFX_BARRAGE_LEN
+                                          ).astype(np.uint8)
+                    barrage_live.add(cli.submit_generate(long_p, 2))
+            for rep in cli.collect(0.01):
+                if rep.get("partial"):
+                    continue
+                rid = rep.get("req_id")
+                pending.discard(rid)
+                if rid in barrage_live:
+                    barrage_live.discard(rid)
+                    barrage_done += 1
+        while cli.in_flight:            # drain the barrage tail
+            cli.collect(0.02)
+        gaps = [b - a for s in stamps for a, b in zip(s, s[1:])]
+        return (round(float(np.percentile(gaps, 99)) * 1e3, 3),
+                len(gaps), barrage_done)
+
+    band_p99, band_gaps, _ = stream_phase(barrage=False)
+    barrage_p99, barrage_gaps, barrage_n = stream_phase(barrage=True)
+
+    recompiles = srv.runner.compiles - warm_compiles
+    jit_cache = srv.runner.jit_cache_size()
+    gen_jit_cache = srv.gen_sched.gen.jit_cache_size()
+    gstats = srv.gen_sched.stats()
+    cli.close()
+    srv.stop()
+
+    bytes_ratio = ((bytes_off / max(emitted_off, 1))
+                   / max(bytes_on / max(emitted_on, 1), 1e-9))
+    print(json.dumps({
+        "metric": "prefix_cache_prefill_token_ratio",
+        "value": round(prefill_ratio, 3),
+        "unit": "prefix_on_vs_off_prompt_tokens_computed",
+        "ratio_ceil": PFX_RATIO_CEIL,
+        "prefill_tokens_off": int(prefill_off),
+        "prefill_tokens_on": int(prefill_on),
+        "prefix_outputs_bit_exact": bool(prefix_exact),
+        "prefix_hits": gstats_mid["prefix_hits"],
+        "prefix_tokens_avoided": gstats_mid["prefix_tokens_avoided"],
+        "shared_prefix_tokens": PFX_SHARED,
+        "model": dict(SEQ_MODEL),
+        "page_size": gstats["page_size"],
+        "num_pages": gstats["num_pages"],
+        "prefill_chunk": gstats["prefill_chunk"],
+        "decode_tick_ms": PFX_TICK_MS,
+        "band_p99_ms": band_p99,
+        "barrage_p99_ms": barrage_p99,
+        "p99_band_factor": PFX_P99_BAND,
+        "band_gaps": band_gaps,
+        "barrage_gaps": barrage_gaps,
+        "barrage_prompts_served": barrage_n,
+        "fetch_bytes_per_token_off": round(bytes_off / max(emitted_off,
+                                                           1), 1),
+        "fetch_bytes_per_token_on": round(bytes_on / max(emitted_on,
+                                                         1), 1),
+        "bytes_ratio": round(bytes_ratio, 1),
+        "bytes_ratio_floor": PFX_BYTES_RATIO,
+        "warm_compiles": warm_compiles,
+        "scoring_buckets": n_buckets,
+        "generation_executables": gen_execs,
+        "recompiles_mixed_stream": recompiles,
+        "jit_cache_size": jit_cache,
+        "gen_jit_cache_size": gen_jit_cache,
+        "cow_copies": gstats["cow_copies"],
+        "pages_leaked": gstats["pages_leaked"],
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if prefill_ratio > PFX_RATIO_CEIL:
+        failures.append(f"prefix-on computed {prefill_ratio:.2f}x the "
+                        f"off run's prompt tokens (ceil "
+                        f"{PFX_RATIO_CEIL}x)")
+    if not prefix_exact:
+        failures.append("decoded streams diverge between prefix-on "
+                        "and prefix-off (bit-exact reuse contract)")
+    if barrage_p99 > PFX_P99_BAND * band_p99:
+        failures.append(f"barrage p99 {barrage_p99}ms outside "
+                        f"{PFX_P99_BAND}x the {band_p99}ms band "
+                        f"(chunked prefill must bound the stall)")
+    if bytes_ratio < PFX_BYTES_RATIO:
+        failures.append(f"logits path only {bytes_ratio:.1f}x the "
+                        f"token path's bytes/token (floor "
+                        f"{PFX_BYTES_RATIO}x)")
+    if recompiles:
+        failures.append(f"{recompiles} recompiles during the mixed "
+                        f"stream (must be 0)")
+    if gstats["pages_leaked"]:
+        failures.append(f"{gstats['pages_leaked']} KV pages leaked "
+                        f"(refcount invariant)")
+    if jit_cache is not None and jit_cache != n_buckets:
+        failures.append(f"scoring jit cache {jit_cache} != "
+                        f"{n_buckets} buckets")
+    if gen_jit_cache is not None and gen_jit_cache != gen_execs:
+        failures.append(f"generation jit cache {gen_jit_cache} != "
+                        f"{gen_execs} executables")
+    if failures:
+        raise SystemExit("prefix gates failed: " + "; ".join(failures))
 
 
 #: --telemetry protocol knobs (ISSUE 5).  Same de-flake discipline as
@@ -3080,7 +3333,7 @@ def elastic_main() -> None:
     path_a = wf_a.snapshotter.save("elastic_a")
     root.common.serving.seq.rungs = list(GEN_SEQ_RUNGS)
     root.common.serving.generate.update({
-        "enabled": True, "cache_rungs": list(GEN_CACHE_RUNGS),
+        "enabled": True, "page_size": GEN_PAGE_SIZE,
         "slots": GEN_SLOTS})
     # dir="" -> the cache lands in aot_cache/ NEXT TO the snapshot
     root.common.serving.aot_cache.update({"enabled": True, "dir": ""})
@@ -3842,6 +4095,8 @@ if __name__ == "__main__":
         seq_main()
     elif "--generate" in args:
         generate_main()
+    elif "--prefix" in args:
+        prefix_main()
     elif "--elastic" in args:
         elastic_main()
     elif "--stream" in args:

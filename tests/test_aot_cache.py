@@ -245,38 +245,39 @@ def test_swap_on_a_warm_boot_stays_compile_free(tmp_path):
 
 
 def test_generation_family_roundtrip_and_parity(tmp_path):
-    """The generation executables (prefill x batch rungs, decode x
-    batch x cache rungs, migrations) roundtrip the cache too: a fresh
-    runner loads the WHOLE family with zero compiles and decodes the
-    same tokens bit-for-bit, including across a rung migration."""
+    """The paged generation executables (prefill/decode per (batch
+    rung, page rung), plus the COW copy) roundtrip the cache too: a
+    fresh runner loads every entry the drive touched with zero
+    compiles and decodes the same tokens bit-for-bit, including across
+    a page-table rung step (1 -> 2 pages) and a COW copy."""
     from znicz_tpu.serving.model import ModelRunner
 
     def boot():
         r = ModelRunner(_charlm_wf())
         assert r.enable_aot_cache(str(tmp_path))
-        return r.enable_generation(cache_rungs=[8, 16], slots=2,
-                                   prompt_rungs=[8])
+        return r.enable_generation(page_size=8, num_pages=8, slots=2,
+                                   prefill_chunk=8, prefix_cache=False,
+                                   prefill_rungs=[1], decode_rungs=[1])
 
     def drive(g):
         rng = np.random.default_rng(17)
         prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
-        rung, toks = 8, []
-        slot = g.alloc(rung)
+        pages = [g.alloc_page()]
         x = np.zeros((1, 8), g.runner.dtype)
         x[0, :5] = prompt
-        logits, _ = g.prefill(x, [5], rung, [slot])
-        toks.append(int(np.argmax(logits[0])))
+        tok, _, _, _ = g.prefill(x, [0], [5], [pages], [0.0], [0], [0])
+        toks = [int(tok[0])]
         t = 5
-        for _ in range(6):                     # crosses the 8->16 rung
-            if t >= rung:
-                ds = g.alloc(16)
-                g.migrate(rung, slot, 16, ds)
-                g.release(rung, slot)
-                rung, slot = 16, ds
-            logits, _ = g.decode(rung, [slot], [toks[-1]], [t])
-            toks.append(int(np.argmax(logits[0])))
+        for _ in range(6):                     # crosses the page boundary
+            if t % g.page_size == 0:
+                pages.append(g.alloc_page())
+            tok, _, _, _ = g.decode([pages], [toks[-1]], [t],
+                                    [0.0], [0], [0])
+            toks.append(int(tok[0]))
             t += 1
-        g.release(rung, slot)
+        dst = g.alloc_page()                   # the COW executable too
+        g.copy_page(pages[0], dst)
+        g.release_pages(pages + [dst])
         return toks
 
     cold = boot()
@@ -293,25 +294,28 @@ def test_generation_family_roundtrip_and_parity(tmp_path):
     assert warm.runner._warm["hits"] == stores
     assert warm.jit_cache_size() == 0
     assert fam == warm.executables()
-    assert warm.slots_active() == 0
+    assert warm.pages_active() == 0 and warm.pages_leaked() == 0
 
 
+@pytest.mark.slow
 def test_generation_full_warmup_roundtrip(tmp_path):
     """``GenerationRunner.warmup()`` (the boot path) over the cache:
-    cold stores the full family, warm loads it — ``loaded == family``
-    with zero compiles, the /readyz equality for the generation
-    plane."""
+    cold stores the full paged family — (prefill rungs + decode rungs)
+    x page rungs + the copy — warm loads it: ``loaded == family`` with
+    zero compiles, the /readyz equality for the generation plane."""
     from znicz_tpu.serving.model import ModelRunner
 
     def boot():
         r = ModelRunner(_charlm_wf())
         assert r.enable_aot_cache(str(tmp_path))
-        return r.enable_generation(cache_rungs=[8, 16], slots=2,
-                                   prompt_rungs=[8])
+        return r.enable_generation(page_size=8, num_pages=8, slots=2,
+                                   prefill_chunk=8,
+                                   prefill_rungs=[1], decode_rungs=[1])
 
     cold = boot()
     fam = cold.warmup()
     assert fam == cold.executables()
+    assert fam == 2 * len(cold.page_rungs) + 1
     assert cold.runner.compiles == fam
     assert cold.runner._aot_cache.counts["stores"] == fam
 

@@ -1,10 +1,12 @@
-"""Autoregressive generation serving (ISSUE 16): the decode-attention
-NaN guard, KV-pool slot/migration accounting, decode-vs-full-forward
-parity ACROSS a cache-rung migration, the continuous-batching
-scheduler (mid-batch release, determinism, resend dedup), the e2e
-``generate`` service (streaming, refusals, neighbor invisibility,
-repeat-stream jit-cache hygiene), the web panel generation row, and a
-chaos soak (slow)."""
+"""Autoregressive generation serving (ISSUE 16, paged in ISSUE 19):
+the decode-attention NaN guard, paged-vs-contiguous bit-exactness,
+page-pool refcount accounting (leak audit), chunked-prefill parity
+with the full forward, prefix-cache hit bit-exactness + copy-on-write
+divergence, the continuous-batching scheduler (mid-batch release,
+determinism, resend dedup, page-pressure stalls), on-device-vs-host
+sampling parity, the e2e ``generate`` service (streaming, refusals,
+neighbor invisibility, repeat-stream jit-cache hygiene), the web panel
+generation rows, and a chaos soak (slow)."""
 
 import json
 import time
@@ -32,14 +34,46 @@ def _charlm_wf(seq_len=32):
     return wf
 
 
-def _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
-                prompt_rungs=(8,)):
+def _gen_runner(wf, page_size=8, num_pages=16, slots=2, prefill_chunk=8,
+                prefix_cache=True):
     from znicz_tpu.serving.model import ModelRunner
 
     runner = ModelRunner(wf)
-    return runner.enable_generation(cache_rungs=list(cache_rungs),
-                                    slots=slots,
-                                    prompt_rungs=list(prompt_rungs))
+    return runner.enable_generation(page_size=page_size,
+                                    num_pages=num_pages, slots=slots,
+                                    prefill_chunk=prefill_chunk,
+                                    prefix_cache=prefix_cache)
+
+
+def _greedy(gen, prompt, n_new, pages=None):
+    """Drive one request by hand through the paged runner: chunked
+    prefill + greedy decode.  Returns (tokens, page list)."""
+    prompt = np.asarray(prompt).reshape(-1)
+    ps, c = gen.page_size, gen.prefill_chunk
+    pages = [] if pages is None else pages
+    t0 = len(pages) * ps if pages else 0
+    t0 = min(t0, len(prompt) - 1)
+    tok = None
+    while t0 < len(prompt):
+        n = min(c, len(prompt) - t0)
+        need = -(-(t0 + n) // ps)
+        while len(pages) < need:
+            pages.append(gen.alloc_page())
+        x = np.zeros((1, c), gen.runner.dtype)
+        x[0, :n] = prompt[t0:t0 + n]
+        tok, _, _, _ = gen.prefill(x, [t0], [n], [pages],
+                                   [0.0], [0], [0])
+        t0 += n
+    toks = [int(tok[0])]
+    t = len(prompt)
+    for _ in range(n_new - 1):
+        if t % ps == 0:
+            pages.append(gen.alloc_page())
+        tok, _, _, _ = gen.decode([pages], [toks[-1]], [t],
+                                  [0.0], [0], [0])
+        toks.append(int(tok[0]))
+        t += 1
+    return toks, pages
 
 
 @pytest.fixture()
@@ -47,7 +81,7 @@ def _generate_config():
     """Enable the generation plane for a server test, restore after."""
     root.common.serving.seq.rungs = [8, 32]
     root.common.serving.generate.update({
-        "enabled": True, "cache_rungs": [8, 16, 32], "slots": 4})
+        "enabled": True, "page_size": 8, "slots": 4})
     yield
     root.common.serving.generate.enabled = False
     root.common.serving.seq.rungs = None
@@ -119,66 +153,136 @@ def test_decode_attention_matches_causal_row():
                                    rtol=1e-6, atol=1e-6)
 
 
-# -- KV pool bookkeeping ------------------------------------------------------
+def test_paged_decode_attention_bit_exact_vs_contiguous():
+    """The paged path is the contiguous path plus a pure gather:
+    ``paged_gather`` over a row's page table reproduces its contiguous
+    cache EXACTLY, so ``paged_decode_attention`` is bit-identical to
+    ``decode_attention`` over the same values — per fixed executable,
+    the ISSUE 19 correctness contract.  Scratch table slots past the
+    fill sit behind ``k_valid`` like the contiguous unwritten tail."""
+    from znicz_tpu.ops.attention import (decode_attention, paged_append,
+                                         paged_decode_attention,
+                                         paged_gather)
+
+    rng = np.random.default_rng(17)
+    ps, n_pages, h, d = 4, 6, 2, 4
+    pool_k = rng.normal(size=(n_pages + 1, ps, h, d)).astype(np.float32)
+    pool_v = rng.normal(size=(n_pages + 1, ps, h, d)).astype(np.float32)
+    # two rows: row 0 owns pages [3, 1], row 1 pages [4, *scratch pad*]
+    table = np.asarray([[3, 1], [4, n_pages]], np.int32)
+    t = np.asarray([6, 2], np.int32)          # fills (page 1 mid, page 0)
+    gk = np.asarray(paged_gather(pool_k, table))
+    np.testing.assert_array_equal(gk[0, :ps], pool_k[3])
+    np.testing.assert_array_equal(gk[0, ps:], pool_k[1])
+    q = rng.normal(size=(2, 1, h, d)).astype(np.float32)
+    paged = np.asarray(paged_decode_attention(q, pool_k, pool_v,
+                                              table, t))
+    contig = np.asarray(decode_attention(
+        q, paged_gather(pool_k, table), paged_gather(pool_v, table), t))
+    np.testing.assert_array_equal(paged, contig)
+    # append lands at (table[i, t//ps], t%ps) — and the pad row's
+    # scratch page never aliases a real one
+    import jax.numpy as jnp
+
+    row = rng.normal(size=(2, h, d)).astype(np.float32)
+    out = np.asarray(paged_append(jnp.asarray(pool_k), table, row, t))
+    np.testing.assert_array_equal(out[1, 6 % ps], row[0])  # page 1
+    np.testing.assert_array_equal(out[4, 2], row[1])
+    np.testing.assert_array_equal(out[3], pool_k[3])       # untouched
 
 
-def test_kv_pool_slot_accounting():
+# -- page pool bookkeeping (leak audit satellite) ------------------------------
+
+
+def test_page_pool_refcount_accounting():
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16), slots=2)
-    # rung resolution
-    assert g._rung_for(5) == 8
-    assert g._rung_for(9) == 16
-    assert g._rung_for(17) is None
-    # alloc to exhaustion, release recycles; scratch is never handed out
-    a, b = g.alloc(8), g.alloc(8)
-    assert {a, b} == {0, 1} and g.scratch not in (a, b)
-    assert g.alloc(8) is None                 # rung exhausted, not scratch
-    assert g.slots_active() == 2
-    assert g.occupancy() == pytest.approx(0.5)
-    g.release(8, a)
-    assert g.alloc(8) == a
-    for s in (a, b):
-        g.release(8, s)
-    assert g.slots_active() == 0
+    g = _gen_runner(wf, page_size=8, num_pages=4, slots=2,
+                    prefix_cache=False)
+    assert g.page_rungs == (1, 2, 4)
+    assert g.max_ctx == 32
+    assert g.executables() == ((len(g.prefill_rungs)
+                                + len(g.decode_rungs)) * 3 + 1)
+    # alloc to exhaustion; scratch is never handed out
+    pages = [g.alloc_page() for _ in range(4)]
+    assert sorted(pages) == [0, 1, 2, 3] and g.scratch not in pages
+    assert g.alloc_page() is None
+    assert g.pages_active() == 4 and g.occupancy() == 1.0
+    # refcounted sharing: a second holder keeps the page alive
+    g.addref(pages[0])
+    g.decref(pages[0])
+    assert g.pages_active() == 4
+    g.release_pages(pages)
+    assert g.pages_active() == 0 and g.pages_leaked() == 0
     st = g.stats()
-    assert st["slots_total"] == 4
-    assert st["executables"] == (len(g.prefill_rungs) * 1
-                                 + len(g.decode_rungs) * 2 + 1)
+    assert st["pages_free"] == 4 and st["pages_leaked"] == 0
+    # over-release is a caught invariant violation, not silent rot
+    p = g.alloc_page()
+    g.decref(p)
+    with pytest.raises(AssertionError):
+        g.decref(p)
 
 
-def test_decode_parity_across_cache_rung_migration():
-    """Greedy decode through the KV pool — prefill, per-token decode,
-    and TWO rung migrations (8 -> 16 -> 32) — matches the classic
-    full-forward teacher-forced on the same growing prefix at every
-    step.  Different executables, so a numerical band, not bytes."""
+def test_prefix_index_eviction_under_pressure():
+    """Idle index-held pages are reclaimed LRU-first when the pool
+    runs dry — a cached prefix costs nothing until allocation wants
+    the page back; pages shared with a LIVE request are never torn
+    away."""
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2)
+    g = _gen_runner(wf, page_size=8, num_pages=4, slots=2)
+    rng = np.random.default_rng(19)
+    p1 = rng.integers(1, VOCAB, size=8)
+    _, pages1 = _greedy(g, p1, 1)
+    g.prefix.register(p1, pages1)
+    g.release_pages(pages1)
+    assert g.stats()["prefix_pages"] == 1
+    assert g.pages_active() == 1              # the index residue
+    # a live hit pins the page: exhaust the pool, eviction must refuse
+    held, covered = g.prefix.lookup(p1)
+    assert covered == 8
+    others = [g.alloc_page() for _ in range(3)]
+    assert all(p is not None for p in others)
+    assert g.alloc_page() is None             # indexed page is SHARED
+    assert g.stats()["prefix_pages"] == 1
+    # release the request: now pressure evicts the idle entry
+    g.release_pages(held)
+    got = g.alloc_page()
+    assert got is not None
+    assert g.stats()["prefix_pages"] == 0
+    assert int(g._pm["evictions"].value) >= 1
+    g.release_pages(others + [got])
+    assert g.pages_active() == 0 and g.pages_leaked() == 0
+
+
+# -- paged decode + chunked prefill vs the classic plane -----------------------
+
+
+def test_paged_decode_parity_with_full_forward():
+    """Greedy decode through the paged pool — chunked prefill, then
+    per-token decode across page boundaries (1 -> 4 pages) — matches
+    the classic full-forward teacher-forced on the same growing prefix
+    at every step.  Different executables, so a numerical band, not
+    bytes."""
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2)
     runner = g.runner
     rng = np.random.default_rng(17)
     prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
-    rung = g._rung_for(len(prompt))
-    slot = g.alloc(rung)
+    pages = [g.alloc_page()]
     x = np.zeros((1, 8), runner.dtype)
     x[0, :5] = prompt
-    logits, _ = g.prefill(x, [5], rung, [slot])
-    toks = [int(np.argmax(logits[0]))]
+    tok, _, logits, _ = g.prefill(x, [0], [5], [pages], [0.0], [0], [0])
+    toks = [int(tok[0])]
     steps = [logits[0]]
-    t = len(prompt)
-    migrations = 0
+    t = 5
     for _ in range(20):
-        if t >= rung:                         # fill outgrew the rung
-            dst = g._rung_for(t + 1)
-            ds = g.alloc(dst)
-            g.migrate(rung, slot, dst, ds)
-            g.release(rung, slot)
-            rung, slot = dst, ds
-            migrations += 1
-        logits, _ = g.decode(rung, [slot], [toks[-1]], [t])
-        toks.append(int(np.argmax(logits[0])))
+        if t % g.page_size == 0:
+            pages.append(g.alloc_page())
+        tok, _, logits, _ = g.decode([pages], [toks[-1]], [t],
+                                     [0.0], [0], [0])
+        toks.append(int(tok[0]))
         steps.append(logits[0])
         t += 1
-    assert migrations == 2                    # crossed 8->16 and 16->32
-    # classic plane: teacher-force the same prefix, read each position
+    assert len(pages) == 4                    # crossed three boundaries
     prefix = list(prompt) + toks[:-1]
     xb = np.zeros((1, 32), runner.dtype)
     xb[0, :len(prefix)] = prefix
@@ -186,8 +290,135 @@ def test_decode_parity_across_cache_rung_migration():
     for i, row in enumerate(steps):
         np.testing.assert_allclose(row, full[len(prompt) - 1 + i],
                                    rtol=1e-5, atol=1e-6)
-    g.release(rung, slot)
-    assert g.slots_active() == 0
+    g.release_pages(pages)
+    assert g.pages_active() == 0 and g.pages_leaked() == 0
+
+
+def test_chunked_prefill_matches_monolithic():
+    """A 24-token prompt prefilled in three 8-token chunks produces
+    the same next-token logits as ONE monolithic full forward over the
+    prompt — within the established cross-executable band (the chunks
+    run a different executable than the full forward)."""
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2)
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(1, VOCAB, size=24).astype(np.uint8)
+    pages = []
+    for i in range(3):
+        pages.append(g.alloc_page())
+        x = np.zeros((1, 8), g.runner.dtype)
+        x[0] = prompt[i * 8:(i + 1) * 8]
+        tok, _, logits, _ = g.prefill(x, [i * 8], [8], [pages],
+                                      [0.0], [0], [0])
+    xb = np.zeros((1, 32), g.runner.dtype)
+    xb[0, :24] = prompt
+    full = g.runner.infer(xb)[0]
+    np.testing.assert_allclose(logits[0], full[23], rtol=1e-5,
+                               atol=1e-6)
+    assert int(tok[0]) == int(np.argmax(full[23]))
+    g.release_pages(pages)
+
+
+def test_prefix_hit_bit_exact_vs_cold_prefill():
+    """A prompt whose full pages hit the prefix index decodes
+    BIT-identically to its cold prefill: with ``prefill_chunk ==
+    page_size`` the hit's tail chunk replays the exact executable grid
+    the cold run used, and decode gathers the very same page values.
+    Logits equal to the byte, not a band."""
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2,
+                    prefill_chunk=8)
+    rng = np.random.default_rng(29)
+    prompt = rng.integers(1, VOCAB, size=20).astype(np.uint8)  # 2 full+4
+
+    def run(expect_hit):
+        hits0 = int(g._pm["hits"].value)
+        pages, covered = g.prefix.lookup(prompt)
+        assert (covered == 16) == expect_hit
+        assert (int(g._pm["hits"].value) == hits0 + 1) == expect_hit
+        toks = []
+        rows = []
+        t0 = covered
+        while t0 < 20:
+            n = min(8, 20 - t0)
+            while len(pages) < -(-(t0 + n) // 8):
+                pages.append(g.alloc_page())
+            x = np.zeros((1, 8), g.runner.dtype)
+            x[0, :n] = prompt[t0:t0 + n]
+            tok, _, logits, _ = g.prefill(x, [t0], [n], [pages],
+                                          [0.0], [0], [0])
+            t0 += n
+        toks.append(int(tok[0]))
+        rows.append(np.asarray(logits[0]))
+        t = 20
+        for _ in range(6):
+            if t % 8 == 0:
+                pages.append(g.alloc_page())
+            tok, _, logits, _ = g.decode([pages], [toks[-1]], [t],
+                                         [0.0], [0], [0])
+            toks.append(int(tok[0]))
+            rows.append(np.asarray(logits[0]))
+            t += 1
+        g.prefix.register(prompt, pages)
+        return toks, rows, pages
+
+    cold_t, cold_r, cold_p = run(expect_hit=False)
+    g.release_pages(cold_p)
+    hit_t, hit_r, hit_p = run(expect_hit=True)
+    g.release_pages(hit_p)
+    assert cold_t == hit_t
+    for a, b in zip(cold_r, hit_r):
+        np.testing.assert_array_equal(a, b)
+    assert int(g._pm["tokens_avoided"].value) == 16
+    assert g.pages_leaked() == 0
+
+
+def test_cow_divergence_keeps_shared_pages_immutable():
+    """Copy-on-write: a second request claiming a full shared page and
+    then writing into it (the full-coverage recompute) writes into a
+    COPY — the donor's page bytes never change, so requests sharing a
+    prefix can never see each other's keys."""
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2)
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(1, VOCAB, size=8).astype(np.uint8)  # 1 full page
+    toksA, pagesA = _greedy(g, prompt, 4)
+    g.prefix.register(prompt, pagesA)
+    shared = pagesA[0]
+    layer = next(iter(g.pk))
+    before_k = np.asarray(g.pk[layer][shared]).copy()
+    # request B: full coverage -> recompute the last prompt token into
+    # the shared page, which must COW first
+    pagesB, covered = g.prefix.lookup(prompt)
+    assert covered == 8 and pagesB == [shared]
+    fresh = g.alloc_page()
+    g.copy_page(shared, fresh)
+    g.decref(shared)
+    pagesB[0] = fresh
+    x = np.zeros((1, 8), g.runner.dtype)
+    x[0, 0] = prompt[7]
+    tokB, _, _, _ = g.prefill(x, [7], [1], [pagesB], [0.0], [0], [0])
+    toksB = [int(tokB[0])]
+    t = 8
+    for _ in range(3):
+        if t % 8 == 0:
+            pagesB.append(g.alloc_page())
+        tokB, _, _, _ = g.decode([pagesB], [toksB[-1]], [t],
+                                 [0.0], [0], [0])
+        toksB.append(int(tokB[0]))
+        t += 1
+    # B's divergent writes landed in the COPY: the donor's shared page
+    # is bit-untouched, and B's greedy continuation agrees with A's
+    # (the page values B read are identical to what A wrote)
+    np.testing.assert_array_equal(np.asarray(g.pk[layer][shared]),
+                                  before_k)
+    assert toksB == toksA
+    assert g.page_ref[fresh] == 1
+    g.release_pages(pagesA)
+    g.release_pages(pagesB)
+    # residue: exactly the index-held page remains
+    assert g.pages_active() == 1 and g.pages_leaked() == 0
+    assert g.stats()["prefix_pages"] == 1
 
 
 # -- continuous batching scheduler --------------------------------------------
@@ -205,12 +436,13 @@ def _run_to_completion(sched, max_rounds=400):
 
 def test_scheduler_continuous_batching():
     """Mixed generations through the scheduler alone: co-batched decode
-    ticks, mid-batch slot release, rung migration, ladder-top
-    truncation, resend dedup, and seeded determinism on a re-run."""
+    ticks, chunked prefill of a long prompt, mid-batch page release,
+    context-window truncation, resend dedup, and determinism on a
+    re-run (which rides the prefix cache the second time)."""
     from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
 
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=4)
+    g = _gen_runner(wf, page_size=8, num_pages=24, slots=4)
     sched = GenerationScheduler(g, max_new_cap=64)
     m = {k: c.value for k, c in sched._m.items()}
     rng = np.random.default_rng(19)
@@ -220,8 +452,10 @@ def test_scheduler_continuous_batching():
                 GenSeq(rng.integers(1, VOCAB, size=5), 12, req_id=2),
                 GenSeq(rng.integers(1, VOCAB, size=7), 6, temperature=0.8,
                        seed=41, req_id=3),
-                # 6 + 30 outgrows the 32-rung ladder top -> truncated
-                GenSeq(rng.integers(1, VOCAB, size=6), 30, req_id=4)]
+                # 6 + 30 outgrows the 32-token context -> truncated
+                GenSeq(rng.integers(1, VOCAB, size=6), 30, req_id=4),
+                # 20 tokens = three prefill chunks before decoding
+                GenSeq(rng.integers(1, VOCAB, size=20), 4, req_id=5)]
 
     first = seqs()
     for s in first:
@@ -231,18 +465,22 @@ def test_scheduler_continuous_batching():
     assert sched._m["gen_dedup"].value == m["gen_dedup"] + 1
     replies = _run_to_completion(sched)
     finals = {r["req_id"]: r for _, r in replies if not r.get("partial")}
-    assert set(finals) == {1, 2, 3, 4}
+    assert set(finals) == {1, 2, 3, 4, 5}
     assert all(r["ok"] for r in finals.values())
     assert len(finals[1]["tokens"]) == 4
     assert len(finals[2]["tokens"]) == 12
     assert "truncated" in finals[4] and len(finals[4]["tokens"]) < 30
-    assert sched._m["migrations"].value > m["migrations"]
     assert sched._m["gen_truncated"].value == m["gen_truncated"] + 1
-    # mid-batch release: short and long budgets finished on their own
-    # schedule, and every slot is back in the pool
-    assert g.slots_active() == 0
+    # the 20-token prompt took >= 3 chunk dispatches
+    assert sched._m["prefill_batches"].value >= m["prefill_batches"] + 3
+    # mid-batch release: pages return as sequences finish on their own
+    # schedule; only the prefix-index residue stays allocated
+    assert g.pages_leaked() == 0
+    assert g.pages_active() == g.stats()["prefix_pages"]
     assert sched._m["decode_batches"].value > m["decode_batches"]
-    # determinism: the same stream (same seeds) emits the same tokens
+    # determinism: the same stream again emits the same tokens — the
+    # second pass HITS the prefix cache and must not diverge
+    hits0 = g.stats()["prefix_hits"]
     rng = np.random.default_rng(19)
     again = seqs()
     for s in again:
@@ -250,20 +488,21 @@ def test_scheduler_continuous_batching():
     replies2 = _run_to_completion(sched)
     finals2 = {r["req_id"]: r for _, r in replies2
                if not r.get("partial")}
-    for rid in (1, 2, 3, 4):
+    for rid in (1, 2, 3, 4, 5):
         np.testing.assert_array_equal(finals[rid]["tokens"],
                                       finals2[rid]["tokens"])
+    assert g.stats()["prefix_hits"] > hits0
 
 
 def test_scheduler_refusals_and_deadline():
     from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
 
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
-                    prompt_rungs=(8, 16))
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2)
     sched = GenerationScheduler(g, max_new_cap=16)
-    ref = sched.submit(GenSeq(np.ones(17, np.uint8), 4))
-    assert ref is not None and "prompt" in ref and ref.policy == "oversized"
+    ref = sched.submit(GenSeq(np.ones(33, np.uint8), 4))
+    assert ref is not None and "context window" in ref \
+        and ref.policy == "oversized"
     ref = sched.submit(GenSeq(np.ones(3, np.uint8), 17))
     assert ref is not None \
         and "root.common.serving.generate.max_new_tokens" in ref
@@ -273,29 +512,27 @@ def test_scheduler_refusals_and_deadline():
     _, reps = sched.step()
     timed = [r for _, r in reps if r.get("timed_out")]
     assert len(timed) == 1 and timed[0]["policy"] == "deadline"
-    assert g.slots_active() == 0
+    assert g.pages_active() == 0
 
 
-# -- slot exhaustion + pending-bound flood (ISSUE 17 satellite) ----------------
-
-
-def test_scheduler_slot_exhaustion_flood_no_leaks():
-    """A flood against ONE KV slot per rung plus a tight pending
-    bound: overflow submits are refused with the ``shed`` policy
-    (never queued, never holding a slot), everything admitted
-    finishes, a deadline expiry mid-generation ships its ``deadline``
-    partial AND releases its slot, and the pool comes back whole —
-    free lists full and duplicate-free."""
+def test_scheduler_page_pressure_flood_no_leaks():
+    """A flood against a page pool sized for ONE request plus a tight
+    pending bound: overflow submits are refused with the ``shed``
+    policy, everything admitted finishes (page pressure stalls rows,
+    never deadlocks them), a deadline expiry mid-generation ships its
+    ``deadline`` partial AND releases its pages, and the pool comes
+    back whole — the leak-audit satellite's terminal invariant."""
     from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
 
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16), slots=1, prompt_rungs=(8,))
+    g = _gen_runner(wf, page_size=8, num_pages=4, slots=2,
+                    prefix_cache=False)
     sched = GenerationScheduler(g, max_new_cap=8, pending_bound=3)
     refused0 = sched._m["gen_refused"].value
     rng = np.random.default_rng(23)
 
-    def seq(rid, max_new=2):
-        return GenSeq(rng.integers(1, VOCAB, size=3).astype(np.uint8),
+    def seq(rid, max_new=2, size=3):
+        return GenSeq(rng.integers(1, VOCAB, size=size).astype(np.uint8),
                       max_new, req_id=rid)
 
     for rid in (1, 2, 3):
@@ -304,86 +541,161 @@ def test_scheduler_slot_exhaustion_flood_no_leaks():
     assert ref is not None and ref.policy == "shed"
     assert "generation queue at bound" in ref
     assert sched._m["gen_refused"].value == refused0 + 1
-    # the flood drains: with one slot the three admitted generations
-    # serialize through the pool, and all of them finish ok
     finals = {r["req_id"]: r for _, r in _run_to_completion(sched)
               if not r.get("partial")}
     assert set(finals) == {1, 2, 3}
     assert all(r["ok"] and len(r["tokens"]) == 2
                for r in finals.values())
-    assert g.slots_active() == 0
+    assert g.pages_active() == 0
 
-    # deadline expiry WHILE holding a slot: the partial ships with the
-    # 'deadline' policy and the slot returns to the pool
-    a, b = seq(10, max_new=6), seq(11, max_new=6)
+    # deadline expiry WHILE holding pages: the partial ships with the
+    # 'deadline' policy and every page returns to the pool
+    a, b = seq(10, max_new=6, size=9), seq(11, max_new=6, size=9)
     assert sched.submit(a) is None and sched.submit(b) is None
-    for _ in range(200):                     # drive until b owns a slot
+    for _ in range(200):                     # drive until b holds pages
         sched.step()
-        if b.slot is not None:
+        if b.pages:
             break
-    assert b.slot is not None
+    assert b.pages
     b.t_deadline = 1e-9                      # absolute clock: expired
     _, reps = sched.step()
     timed = [r for _, r in reps if r.get("timed_out")]
     assert len(timed) == 1 and timed[0]["req_id"] == 11
     assert timed[0]["policy"] == "deadline"
     _run_to_completion(sched)
-    assert g.slots_active() == 0
-    # the pool invariant the whole satellite rides: every slot is back
-    # exactly once, and scratch was never handed out
-    for rung, free in g._free.items():
-        assert sorted(free) == list(range(g.slots)), rung
+    # the pool invariant the whole satellite rides: every page is back
+    # exactly once (free list duplicate-free), refcounts all zero
+    assert g.pages_active() == 0 and g.pages_leaked() == 0
+    assert sorted(g._free_pages) == list(range(g.num_pages))
+    assert not g.page_ref.any()
     # the queue is open again after the drain
     assert sched.submit(seq(20)) is None
     finals = {r["req_id"]: r for _, r in _run_to_completion(sched)
               if not r.get("partial")}
     assert finals[20]["ok"]
-    assert g.slots_active() == 0
+    assert g.pages_active() == 0
 
 
 @pytest.mark.slow
-def test_scheduler_flood_soak_slots_never_leak():
-    """Churn soak: 60 mixed-size generations pushed through 2 slots
-    and a bound-8 queue, re-submitting every shed until admitted, a
-    third of them carrying tight deadlines.  Every admitted request
-    gets EXACTLY one terminal reply (final, truncated, or deadline
-    partial), and the pool ends whole."""
+def test_page_refcounts_return_to_prefix_residue():
+    """Leak audit with sharing ON: after every termination flavor (ok,
+    deadline partial, drain) the pool holds EXACTLY the shared-prefix
+    residue — every allocated page is refcount-1 and index-held, and
+    ``pages_leaked`` stays 0 throughout."""
     from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
 
     wf = _charlm_wf(seq_len=32)
-    g = _gen_runner(wf, cache_rungs=(8, 16, 32), slots=2,
-                    prompt_rungs=(8,))
-    sched = GenerationScheduler(g, max_new_cap=24, pending_bound=8)
-    rng = np.random.default_rng(29)
-    todo = [GenSeq(rng.integers(1, VOCAB,
-                                size=int(rng.integers(2, 8))
-                                ).astype(np.uint8),
-                   int(rng.integers(1, 20)), req_id=1000 + i,
-                   deadline_s=(0.05 if i % 3 == 0 else None))
-            for i in range(60)]
-    terminal: dict = {}
-    sheds = 0
-    while todo or sched.work_available():
-        while todo:
-            ref = sched.submit(todo[0])
-            if ref is not None:
-                assert ref.policy == "shed"
-                sheds += 1
-                break                        # queue full — go step
-            todo.pop(0)
-        _, reps = sched.step()
-        for _, r in reps:
-            if r.get("partial"):
-                continue
-            assert r["req_id"] not in terminal, "duplicate terminal"
-            terminal[r["req_id"]] = r
-    assert len(terminal) == 60
-    assert sheds > 0                         # the bound actually bit
-    assert any(r.get("timed_out") for r in terminal.values())
-    assert any(r.get("ok") for r in terminal.values())
-    assert g.slots_active() == 0
-    for rung, free in g._free.items():
-        assert sorted(free) == list(range(g.slots)), rung
+    g = _gen_runner(wf, page_size=8, num_pages=24, slots=4)
+    sched = GenerationScheduler(g, max_new_cap=16)
+    rng = np.random.default_rng(37)
+    shared = rng.integers(1, VOCAB, size=16).astype(np.uint8)
+
+    def residue_ok():
+        st = g.stats()
+        assert st["pages_leaked"] == 0
+        assert st["pages_active"] == st["prefix_pages"]
+        held = [p for p in range(g.num_pages) if g.page_ref[p] > 0]
+        assert all(g.page_ref[p] == 1 for p in held)
+        assert len(held) == st["prefix_pages"]
+
+    # ok finishes (two share the 16-token prefix)
+    for rid in (1, 2):
+        tail = rng.integers(1, VOCAB, size=3).astype(np.uint8)
+        assert sched.submit(GenSeq(np.concatenate([shared, tail]), 3,
+                                   req_id=rid)) is None
+    finals = {r["req_id"]: r for _, r in _run_to_completion(sched)
+              if not r.get("partial")}
+    assert finals[1]["ok"] and finals[2]["ok"]
+    residue_ok()
+    # deadline partial mid-generation
+    s = GenSeq(np.concatenate(
+        [shared, rng.integers(1, VOCAB, size=2).astype(np.uint8)]),
+        12, req_id=3)
+    assert sched.submit(s) is None
+    for _ in range(50):
+        sched.step()
+        if s.tokens:
+            break
+    s.t_deadline = 1e-9
+    _run_to_completion(sched)
+    residue_ok()
+    assert g.stats()["prefix_hits"] >= 1     # rid 3 claimed the prefix
+    # drain (shutdown) with work in flight
+    assert sched.submit(GenSeq(shared, 8, req_id=4)) is None
+    sched.step()
+    reps = sched.drain()
+    assert any(r.get("policy") == "draining" for _, r in reps)
+    residue_ok()
+
+
+def test_on_device_vs_host_sampling_greedy_bit_identical():
+    """The ``on_device_sampling`` knob only changes WHAT ships over
+    D2H — (b,) argmax tokens vs (b, vocab) logits argmax'd on the
+    host — so greedy streams are bit-identical across it, and the
+    device path moves a small fraction of the bytes."""
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2,
+                    prefix_cache=False)
+    rng = np.random.default_rng(41)
+    prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
+
+    def run(on_device):
+        sched = GenerationScheduler(g, max_new_cap=16,
+                                    on_device_sampling=on_device)
+        b0 = int(sched._m["fetch_bytes"].value)
+        assert sched.submit(GenSeq(prompt, 8, req_id=1)) is None
+        finals = {r["req_id"]: r
+                  for _, r in _run_to_completion(sched)
+                  if not r.get("partial")}
+        return (finals[1]["tokens"],
+                int(sched._m["fetch_bytes"].value) - b0)
+
+    dev_toks, dev_bytes = run(on_device=True)
+    host_toks, host_bytes = run(on_device=False)
+    np.testing.assert_array_equal(dev_toks, host_toks)
+    # tokens are 4 B/row vs vocab*4 B/row of logits
+    assert dev_bytes * 4 <= host_bytes
+    assert g.pages_active() == 0 and g.pages_leaked() == 0
+
+
+def test_scheduler_logprobs_and_logits():
+    """``return_logprobs`` ships one float per emitted token (both
+    sampling paths agree within float32 vs float64 noise) and
+    ``return_logits`` still works with fused sampling on."""
+    from znicz_tpu.serving.batcher import GenSeq, GenerationScheduler
+
+    wf = _charlm_wf(seq_len=32)
+    g = _gen_runner(wf, page_size=8, num_pages=16, slots=2,
+                    prefix_cache=False)
+    rng = np.random.default_rng(43)
+    prompt = rng.integers(1, VOCAB, size=5).astype(np.uint8)
+
+    def run(on_device):
+        sched = GenerationScheduler(g, max_new_cap=16,
+                                    on_device_sampling=on_device)
+        assert sched.submit(GenSeq(prompt, 5, req_id=1,
+                                   return_logprobs=True,
+                                   return_logits=True)) is None
+        finals = {r["req_id"]: r
+                  for _, r in _run_to_completion(sched)
+                  if not r.get("partial")}
+        return finals[1]
+
+    a = run(on_device=True)
+    b = run(on_device=False)
+    assert a["logprobs"].shape == (5,) and a["logits"].shape == (5, VOCAB)
+    np.testing.assert_array_equal(a["tokens"], b["tokens"])
+    np.testing.assert_allclose(a["logprobs"], b["logprobs"],
+                               rtol=1e-5, atol=1e-6)
+    # the logprob IS the log-softmax of the shipped logits row
+    z = a["logits"][0].astype(np.float64)
+    z -= z.max()
+    want = z[a["tokens"][0]] - np.log(np.exp(z).sum())
+    np.testing.assert_allclose(a["logprobs"][0], want, rtol=1e-5,
+                               atol=1e-6)
+    assert g.pages_active() == 0
 
 
 # -- e2e service --------------------------------------------------------------
@@ -391,9 +703,9 @@ def test_scheduler_flood_soak_slots_never_leak():
 
 def test_e2e_generate_service(_generate_config):
     """The ``generate`` request kind end-to-end: greedy + seeded
-    determinism over the wire, streamed partials, refusals naming the
-    config knob, neighbor invisibility, truncation, stats export, and
-    jit-cache hygiene over a repeated mixed stream."""
+    determinism over the wire, streamed partials, logprobs, refusals
+    naming the config knob, neighbor invisibility, truncation, stats
+    export, and jit-cache hygiene over a repeated mixed stream."""
     from znicz_tpu.serving import InferenceClient, InferenceServer
     from znicz_tpu.serving.client import InferenceError
 
@@ -420,6 +732,10 @@ def test_e2e_generate_service(_generate_config):
         fin = cli.result(rid)
         assert [i for i, _ in got] == list(range(6))
         np.testing.assert_array_equal([t for _, t in got], fin["tokens"])
+        # token logprobs ride the token-sized reply
+        lp = cli.generate(prompt, 4, return_logprobs=True)
+        assert lp["logprobs"].shape == (4,)
+        assert np.all(lp["logprobs"] <= 0)
         # neighbor invisibility: the greedy probe co-batched with
         # sampled neighbors answers exactly like it did solo
         rid_p = cli.submit_generate(prompt, 6)
@@ -428,28 +744,43 @@ def test_e2e_generate_service(_generate_config):
                     temperature=1.1, seed=100 + k) for k in range(2)]
         reps = {r: cli.result(r) for r in [rid_p] + rids}
         np.testing.assert_array_equal(reps[rid_p]["tokens"], a["tokens"])
-        # refusals name the knob / ladder; service stays up
-        with pytest.raises(InferenceError, match="prompt"):
+        # prefix reuse over the wire: a long prompt twice — the second
+        # run computes only its unshared tail
+        long_p = rng.integers(1, VOCAB, size=26).astype(np.uint8)
+        st0 = srv.stats()["generate"]
+        r1 = cli.generate(long_p, 4)
+        st1 = srv.stats()["generate"]
+        r2 = cli.generate(long_p, 4)
+        st2 = srv.stats()["generate"]
+        np.testing.assert_array_equal(r1["tokens"], r2["tokens"])
+        cold = st1["prefill_tokens"] - st0["prefill_tokens"]
+        warm = st2["prefill_tokens"] - st1["prefill_tokens"]
+        assert cold == 26 and warm <= 2, (cold, warm)
+        # refusals name the knob / window; service stays up
+        with pytest.raises(InferenceError, match="context window"):
             cli.generate(np.ones(33, np.uint8), 4)
         with pytest.raises(InferenceError,
                            match="generate.max_new_tokens"):
             cli.generate(prompt, 10 ** 6)
-        # ladder-top truncation is a readable finish, not an error
+        # context-window truncation is a readable finish, not an error
         t = cli.generate(prompt, 40)
         assert t.get("truncated") and len(t["tokens"]) < 40
         # stats + telemetry surface
         st = srv.stats()["generate"]
-        assert st["gen_finished"] >= 8 and st["slots_active"] == 0
+        assert st["gen_finished"] >= 8
         assert st["generated_tokens"] >= 8 * 6
-        assert st["migrations"] >= 1      # the truncated run climbed rungs
+        assert st["pages_leaked"] == 0
+        assert st["pages_active"] == st["prefix_pages"]
+        assert st["prefix_hits"] >= 1
         assert st["inter_token_p99_ms"] is not None
         # jit-cache hygiene: the same mixed stream again compiles NOTHING
-        warm = srv.runner.compiles
+        warm_c = srv.runner.compiles
         cache = srv.gen_sched.gen.jit_cache_size()
         cli.generate(prompt, 6)
         cli.generate(prompt, 6, temperature=0.9, top_k=8, seed=37)
         cli.generate(prompt, 40)
-        assert srv.runner.compiles == warm
+        cli.generate(long_p, 4)
+        assert srv.runner.compiles == warm_c
         assert srv.gen_sched.gen.jit_cache_size() in (None, cache)
     finally:
         cli.close()
@@ -496,11 +827,14 @@ def test_web_status_generation_row(_generate_config):
         gen = snap["serving"]["generate"]
         assert gen["gen_finished"] >= 1
         assert gen["generated_tokens"] >= 6
-        assert gen["cache_rungs"] == [8, 16, 32]
+        assert gen["page_size"] == 8
+        assert gen["pages_leaked"] == 0
+        assert gen["prefix_enabled"] is True
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{status.port}/") as r:
             page = r.read().decode()
-        assert "generation" in page and "KV slots" in page
+        assert "generation" in page and "KV pages" in page
+        assert "prefix cache" in page and "COW copies" in page
     finally:
         cli.close()
         status.stop()
@@ -512,7 +846,9 @@ def test_generate_chaos_soak(_generate_config):
     """Generations through a ChaosProxy (drop/corrupt/dup/delay both
     ways): every request eventually answers, resends of in-flight
     generations are deduplicated (never re-executed), greedy streams
-    stay deterministic, and nothing recompiles after the first pass."""
+    stay deterministic, nothing recompiles after the first pass, and
+    the page pool ends at EXACTLY the shared-prefix residue — the
+    leak-audit satellite under fault injection."""
     from znicz_tpu.parallel.chaos import ChaosProxy, FaultSchedule
     from znicz_tpu.serving import InferenceClient, InferenceServer
 
@@ -529,10 +865,18 @@ def test_generate_chaos_soak(_generate_config):
                           resend_after_s=0.3, breaker_failures=0)
     rng = np.random.default_rng(29)
     try:
-        # clean-path references (direct, pre-chaos traffic shapes)
+        # clean-path references (direct, pre-chaos traffic shapes);
+        # half the prompts share an 8-token prefix page to keep the
+        # prefix cache and COW machinery in the blast radius
         ref_cli = InferenceClient(srv.endpoint, timeout=60)
-        prompts = [rng.integers(1, VOCAB, size=int(rng.integers(2, 8))
-                                ).astype(np.uint8) for _ in range(12)]
+        shared = rng.integers(1, VOCAB, size=8).astype(np.uint8)
+        prompts = []
+        for i in range(12):
+            tail = rng.integers(1, VOCAB,
+                                size=int(rng.integers(2, 8))
+                                ).astype(np.uint8)
+            prompts.append(np.concatenate([shared, tail])
+                           if i % 2 else tail)
         want = [ref_cli.generate(p, 8)["tokens"] for p in prompts]
         ref_cli.close()
         # concurrent chaos traffic co-batches deeper than the serial
@@ -551,7 +895,14 @@ def test_generate_chaos_soak(_generate_config):
         for rid, w in zip(rids, want):
             np.testing.assert_array_equal(got[rid], w)
         assert srv.runner.compiles == warm
-        assert srv.gen_sched.gen.slots_active() == 0
+        # terminal page invariant under chaos: every non-free page is
+        # exactly the refcount-1 prefix-index residue, none leaked
+        g = srv.gen_sched.gen
+        st = g.stats()
+        assert st["pages_leaked"] == 0
+        assert st["pages_active"] == st["prefix_pages"]
+        held = [p for p in range(g.num_pages) if g.page_ref[p] > 0]
+        assert all(g.page_ref[p] == 1 for p in held)
     finally:
         cli.close()
         proxy.stop()

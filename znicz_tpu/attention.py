@@ -145,6 +145,32 @@ class MultiHeadAttention(ForwardBase):
         y = o.reshape(b, t, h * d) @ params["wo"]
         return (x + y if self.residual else y), k, v
 
+    def apply_prefill_chunk(self, params, x, k_view, v_view, t0):
+        """Chunked prefill (ISSUE 19): ``x`` is one chunk's
+        (batch, chunk, embed) hiddens whose row ``i`` sits at GLOBAL
+        positions ``t0[i] .. t0[i] + chunk - 1``; ``k_view``/``v_view``
+        are (batch, ctx, heads, head_dim) gathered paged-cache views
+        already holding each row's prefix ``[0 .. t0)``.  Writes the
+        chunk's k/v at its global positions (positions past the view
+        drop), attends causally with per-row offsets — positions past
+        each query (a reused page's stale tail, pad tokens' keys) are
+        causally dead — and returns ``(y, k_rows, v_rows)`` for the
+        caller to persist into the paged pool."""
+        import jax.numpy as jnp
+
+        b, c, e = x.shape
+        h, d = self.heads, self.head_dim
+        q = (x @ params["wq"]).reshape(b, c, h, d)
+        k_rows = (x @ params["wk"]).reshape(b, c, h, d)
+        v_rows = (x @ params["wv"]).reshape(b, c, h, d)
+        idx = t0[:, None] + jnp.arange(c)
+        rows_b = jnp.arange(b)[:, None]
+        k_cache = k_view.at[rows_b, idx].set(k_rows, mode="drop")
+        v_cache = v_view.at[rows_b, idx].set(v_rows, mode="drop")
+        o = attention(q, k_cache, v_cache, causal=True, q_offset=t0)
+        y = o.reshape(b, c, h * d) @ params["wo"]
+        return (x + y if self.residual else y), k_rows, v_rows
+
     def apply_decode(self, params, x_t, k_cache, v_cache, t):
         """One autoregressive step: ``x_t`` is this step's hidden row
         (batch, 1, embed) at per-row global position ``t`` ((batch,)
@@ -231,6 +257,21 @@ class CharEmbedding(ForwardBase):
         t = x.shape[1]
         return jnp.take(params["embed"], ids, axis=0) \
             + params["pos"][:t][None]
+
+    def apply_offset(self, params, x, t0):
+        """A chunk's embedding at per-row global offsets (ISSUE 19's
+        chunked prefill): ``x`` is (batch, chunk) ids whose row ``i``
+        sits at positions ``t0[i] .. t0[i] + chunk - 1``.  Same tables,
+        same clip as :meth:`apply`; positions are gathered per row (and
+        clip at the table top like apply_decode — pad tokens past the
+        window read a valid row whose output is discarded)."""
+        import jax.numpy as jnp
+
+        ids = jnp.clip(x.astype(jnp.int32), 0, self.vocab - 1)
+        pos = jnp.clip(t0[:, None] + jnp.arange(x.shape[1]), 0,
+                       self.max_len - 1)
+        return jnp.take(params["embed"], ids, axis=0) \
+            + jnp.take(params["pos"], pos, axis=0)
 
     def apply_decode(self, params, tokens, t):
         """One decode step's embedding: ``tokens`` is (batch,) — this

@@ -151,6 +151,15 @@ class Launcher:
                                  "engine.mesh.model with --slave) — "
                                  "wide FC layers column-shard over N "
                                  "devices")
+        parser.add_argument("--generate", action="store_true",
+                            help="with --serve: also speak the "
+                                 "'generate' request kind — paged-KV "
+                                 "autoregressive generation with "
+                                 "prefix reuse, chunked prefill and "
+                                 "fused sampling (root.common.serving."
+                                 "generate.enabled; knobs: generate."
+                                 "page_size/num_pages/prefill_chunk/"
+                                 "prefix_cache/on_device_sampling)")
         parser.add_argument("--announce", default=None,
                             metavar="BALANCER",
                             help="with --serve: heartbeat this replica "
@@ -240,6 +249,8 @@ class Launcher:
             root.common.serving.aot_cache.enabled = True
             if args.aot_cache != "auto":
                 root.common.serving.aot_cache.dir = str(args.aot_cache)
+        if args.generate:
+            root.common.serving.generate.enabled = True
         if args.mesh_data is not None or args.mesh_model is not None:
             if args.slave is not None:
                 # a pod-sliced TRAINING leaf (ISSUE 18): the mesh flags

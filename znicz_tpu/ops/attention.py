@@ -12,6 +12,14 @@ framework handles long sequences at the scale the task demands:
     prefix ``[0..t]`` of a preallocated cache, the unwritten tail masked
     by ``k_valid``.  O(cache) per emitted token instead of O(seq^2) for a
     re-prefill;
+  - ``paged_gather`` / ``paged_append`` / ``paged_decode_attention`` —
+    the BLOCK-PAGED pool forms of the above (ISSUE 19): one
+    ``(num_pages + 1, page_size, heads, dim)`` pool per layer holds every
+    request's cache as page-table-indexed blocks (the last page is pad
+    scratch), so requests share read-only prefix pages by table entry
+    instead of by copy.  Positions stay GLOBAL (``t`` -> page
+    ``t // page_size``, offset ``t % page_size``), which keeps the
+    contiguous path's masking — and its bit-exactness contract — intact;
   - ``ring_attention(q, k, v, axis_name, causal)`` — blockwise attention
     for SEQUENCE-PARALLEL inputs: every device of the mesh axis holds a
     sequence shard of q/k/v; k/v blocks rotate around the ring via
@@ -39,6 +47,11 @@ def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0,
     key positions carry exactly zero probability mass, making each row's
     output a pure function of its OWN unpadded length.
 
+    ``q_offset``/``k_offset`` may be scalars (a sharded block's global
+    start) or per-row (batch,) arrays (ISSUE 19's chunked prefill: each
+    co-batched row's chunk sits at its own depth).  The scalar path's
+    mask is unchanged bit for bit — the row axis merely broadcasts.
+
     A query row whose keys are ALL masked (the empty-cache decode edge)
     returns zeros rather than NaN: masked scores get a finite fill (not
     ``-inf``, whose ``exp(-inf - -inf)`` poisons the softmax), masked
@@ -52,9 +65,13 @@ def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0,
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
     dead = None                                        # (b, h, q, k) bcast
     if causal:
-        qpos = q_offset + jnp.arange(q.shape[1])
-        kpos = k_offset + jnp.arange(k.shape[1])
-        dead = kpos[None, None, None, :] > qpos[None, None, :, None]
+        qpos = jnp.asarray(q_offset)[..., None] + jnp.arange(q.shape[1])
+        kpos = jnp.asarray(k_offset)[..., None] + jnp.arange(k.shape[1])
+        if qpos.ndim == 1:                             # scalar offset
+            qpos = qpos[None]
+        if kpos.ndim == 1:
+            kpos = kpos[None]
+        dead = kpos[:, None, None, :] > qpos[:, None, :, None]
     if k_valid is not None:
         miss = ~k_valid[:, None, None, :]
         dead = miss if dead is None else (dead | miss)
@@ -94,6 +111,46 @@ def decode_attention(q1, k_cache, v_cache, t):
     cache_len = k_cache.shape[1]
     k_valid = jnp.arange(cache_len)[None, :] <= t[:, None]
     return attention(q1, k_cache, v_cache, k_valid=k_valid)
+
+
+def paged_gather(pool, table):
+    """Gather a per-request contiguous K/V view out of a block-paged
+    pool (ISSUE 19).  ``pool`` is (num_pages + 1, page_size, heads, dim)
+    — the LAST page is pad scratch — and ``table`` is (batch, P) int32
+    page ids listing each row's pages in position order (slots past a
+    row's allocation point at scratch).  Returns
+    (batch, P * page_size, heads, dim): position ``t`` of row ``i``
+    lives at page ``table[i, t // page_size]`` offset ``t % page_size``,
+    so downstream masking keeps using GLOBAL positions unchanged."""
+    b, npages = table.shape
+    page_size = pool.shape[1]
+    return pool[table].reshape(b, npages * page_size,
+                               pool.shape[2], pool.shape[3])
+
+
+def paged_append(pool, table, row, t):
+    """Scatter one step's (batch, heads, dim) row into the paged pool at
+    per-row GLOBAL position ``t``: page ``table[i, t // page_size]``,
+    offset ``t % page_size``.  Pure — returns the updated pool.  Rows
+    whose table entry is the scratch page (pad rows) scatter there and
+    never touch a real page."""
+    import jax.numpy as jnp
+
+    b, npages = table.shape
+    page_size = pool.shape[1]
+    page = table[jnp.arange(b), jnp.clip(t // page_size, 0, npages - 1)]
+    return pool.at[page, t % page_size].set(row)
+
+
+def paged_decode_attention(q1, k_pool, v_pool, table, t):
+    """:func:`decode_attention` over the block-paged pool: gather each
+    row's pages into its contiguous view, then run the SAME masked
+    softmax over ``[0..t]`` — the unwritten/stale page tail past ``t``
+    (including scratch table slots) is excluded by ``k_valid`` exactly
+    as the contiguous path excludes its unwritten tail, so paging
+    preserves the per-decoded-token bit-exactness contract."""
+    return decode_attention(q1, paged_gather(k_pool, table),
+                            paged_gather(v_pool, table), t)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):

@@ -39,15 +39,17 @@ the client, and zero-downtime snapshot rollover (``swap`` control
 command / SIGHUP; every reply carries its snapshot ``gen``) with
 ``/healthz``/``/readyz`` on web_status.
 
-Generation serving (ISSUE 16): with ``root.common.serving.generate.
-enabled`` the frontend also speaks a ``generate`` request kind —
-prompt in, autoregressive tokens out.  One prefill fills a bucketed
-KV-cache slot from the prompt, then O(cache) decode steps emit one
-token each; decode steps from DIFFERENT requests coalesce every tick
-(continuous batching), finished sequences release their slot
-mid-batch, and a cache page migrates up a power-of-two rung when its
-fill outgrows it — the zero-recompile contract extended to the
-(batch rung x cache rung) decode family.
+Generation serving (ISSUE 16, paged in ISSUE 19): with
+``root.common.serving.generate.enabled`` the frontend also speaks a
+``generate`` request kind — prompt in, autoregressive tokens out.
+Prompts prefill in fixed ``prefill_chunk`` token chunks into a
+block-paged KV pool (full pages content-addressed and shared across
+requests via the prefix cache, copy-on-write on divergence), then
+O(cache) decode steps emit one token each with sampling fused
+in-graph; decode steps from DIFFERENT requests coalesce every tick
+(continuous batching) and finished sequences release their pages
+mid-batch — the zero-recompile contract extended to the
+(batch rung x page rung) prefill/decode families.
 
 Config home: ``root.common.serving.{max_batch, max_delay_ms,
 queue_bound, request_ttl_s}`` + ``root.common.serving.admission.*``

@@ -27,7 +27,11 @@ cold compile; serialize-load ~3 orders faster on larger families).
     jax/jaxlib/backend/platform versions (an XLA upgrade silently
     invalidates the whole family — different digest, clean miss);
   - the ENTRY key names one executable within the family: the scoring
-    bucket shape, or the generation (kind, rungs) tuple.
+    bucket shape, or the generation (kind, rungs) tuple — paged
+    generation entries (ISSUE 19: ``prefill``/``decode`` keyed (batch
+    rung, page rung), plus the ``copy`` COW move) also carry the
+    (page_size, num_pages, prefill_chunk) geometry, so two boots with
+    different paging never share an entry.
 
 A version bump, mesh change, or architecture change can therefore
 never load a stale executable — the filename itself diverges.  Entries

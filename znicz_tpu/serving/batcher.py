@@ -33,19 +33,22 @@ distinct batch shape is a fresh XLA compile).  The batcher attacks both:
     ``deadline`` / ``draining``) so a client can tell WHICH policy
     refused it.  Config home: ``root.common.serving.admission.*``.
 
-**Continuous batching** (ISSUE 16): :class:`GenerationScheduler` runs
-the autoregressive generation plane next to the classic batcher.
-Prefill (one full forward over the prompt) and decode (one token per
-tick) dispatch as SEPARATE bucket families: every tick, the decode
-steps of ALL live generations sharing a cache rung coalesce into one
-(decode-rung x cache-rung) executable — requests join mid-batch as
-their prefill lands and leave mid-batch the tick they finish (their
-KV slot is released immediately, claimable by the next prefill the
-same tick).  A request outgrowing its cache rung migrates up one rung
-between ticks.  Sampling is host-side per sequence (greedy, or
-seeded temperature/top-k), so a token stream is a deterministic pure
-function of its own prompt + sampling params + the pinned
-executables — co-batched neighbors are invisible.
+**Continuous batching** (ISSUE 16, paged in ISSUE 19):
+:class:`GenerationScheduler` runs the autoregressive generation plane
+next to the classic batcher.  Prefill and decode dispatch as SEPARATE
+bucket families: every tick, the decode steps of ALL live generations
+sharing a page-table rung coalesce into one (decode-rung x page-rung)
+executable — requests join mid-batch as their prefill lands and leave
+mid-batch the tick they finish (their KV pages release immediately,
+claimable the same tick).  Long prompts prefill in fixed
+``prefill_chunk`` token chunks co-scheduled with decode ticks, so a
+prompt's length bounds how MANY ticks it spans, never how long one
+tick runs; prompts sharing indexed prefix pages skip them outright
+(prefix cache, copy-on-write on divergence).  Sampling (greedy, or
+seeded temperature/top-k) is fused into the executables, so a token
+stream is a deterministic pure function of its own prompt + sampling
+params + the pinned executables — co-batched neighbors are invisible
+— and a tick's reply is token-sized, not vocab-sized.
 
 Threading contract: ``submit`` may be called from the frontend's router
 thread; ``next_batch`` from the single compute thread.  All state is
@@ -793,23 +796,31 @@ class DynamicBatcher:
 
 class GenSeq:
     """One generation request through its whole life: pending (prompt
-    queued, no slot) -> active (slot held, decoding one token per tick)
-    -> finished.  ``t`` is the cache fill: prompt_len after prefill,
-    +1 per decode tick (the input token lands at position ``t``).
-    Sampling state is per-sequence host state — a seeded
-    ``np.random.Generator`` — so the emitted stream is deterministic
-    and independent of co-batched neighbors."""
+    queued) -> active (holding a page-table cache; prefilling in
+    ``prefill_chunk`` token chunks, then decoding one token per tick)
+    -> finished.  ``prefilled`` counts prompt positions whose k/v are
+    in the cache (prefix-cache hits start it > 0); ``t`` is the total
+    cache fill once decoding starts.  ``pages`` is the request's page
+    table — plain host ints, so "cache growth" is a list append.
+
+    Sampling is per-sequence and deterministic under a seed on BOTH
+    paths: the fused in-graph sampler keys off ``seed_val`` (device
+    path), the host fallback off a seeded ``np.random.Generator`` —
+    either way neighbors share nothing."""
 
     __slots__ = ("prompt", "prompt_len", "max_new", "temperature",
-                 "top_k", "rng", "stream", "return_logits", "reply_to",
-                 "req_id", "trace_id", "client", "t_enqueued",
-                 "t_deadline", "rung", "slot", "t", "tokens", "logits",
+                 "top_k", "rng", "seed_val", "stream", "return_logits",
+                 "return_logprobs", "reply_to", "req_id", "trace_id",
+                 "client", "t_enqueued", "t_deadline", "pages",
+                 "prefilled", "t", "tokens", "logits", "logprobs",
                  "gen", "t_last", "order")
 
     def __init__(self, prompt, max_new: int, temperature: float = 0.0,
                  top_k: int = 0, seed=None, stream: bool = False,
-                 return_logits: bool = False, reply_to=None, req_id=None,
-                 trace_id=None, client=None, deadline_s=None):
+                 return_logits: bool = False,
+                 return_logprobs: bool = False, reply_to=None,
+                 req_id=None, trace_id=None, client=None,
+                 deadline_s=None):
         import numpy as np
 
         self.prompt = np.asarray(prompt).reshape(-1)
@@ -819,8 +830,12 @@ class GenSeq:
         self.top_k = int(top_k)
         self.rng = (np.random.default_rng(seed)
                     if self.temperature > 0 else None)
+        self.seed_val = (int(seed) & 0xFFFFFFFF if seed is not None
+                         else int(np.random.default_rng()
+                                  .integers(0, 2**32)))
         self.stream = bool(stream)
         self.return_logits = bool(return_logits)
+        self.return_logprobs = bool(return_logprobs)
         self.reply_to = reply_to
         self.req_id = req_id
         self.trace_id = trace_id
@@ -828,20 +843,22 @@ class GenSeq:
         self.t_enqueued = time.perf_counter()
         self.t_deadline = (None if deadline_s is None
                            else self.t_enqueued + float(deadline_s))
-        self.rung = None                # cache rung once a slot is held
-        self.slot = None
+        self.pages: List[int] = []      # the request's page table
+        self.prefilled = 0              # prompt positions cached so far
         self.t = 0                      # cache fill (positions written)
         self.tokens: List[int] = []     # emitted so far
         self.logits = [] if return_logits else None
+        self.logprobs = [] if return_logprobs else None
         self.gen = None                 # snapshot generation stamp
         self.t_last = None              # last emit time (inter-token)
         self.order = 0                  # arrival index (FIFO grouping)
 
     def sample(self, row) -> int:
-        """Next token from one (vocab,) logits row: greedy argmax at
-        temperature 0 (deterministic, tie -> lowest id), else seeded
-        softmax sampling over the optional top-k cut.  Host-side and
-        per-sequence: neighbors share nothing."""
+        """Next token from one (vocab,) logits row — the HOST sampling
+        path (``on_device_sampling`` off): greedy argmax at temperature
+        0 (deterministic, tie -> lowest id, bit-identical to the fused
+        in-graph argmax), else seeded softmax sampling over the
+        optional top-k cut."""
         import numpy as np
 
         if self.temperature <= 0:
@@ -856,24 +873,45 @@ class GenSeq:
         return int(self.rng.choice(z.shape[0], p=p))
 
 
+def _host_logp(row, token: int) -> float:
+    """log p(token) under one (vocab,) logits row, float64 host math —
+    the ``return_logprobs`` fallback when logits were fetched anyway."""
+    import numpy as np
+
+    z = row.astype(np.float64)
+    z -= z.max()
+    return float(z[token] - np.log(np.exp(z).sum()))
+
+
 class GenerationScheduler:
-    """Continuous batching over a :class:`GenerationRunner` (module
-    docstring).  ``submit`` enqueues from the router thread; ``step``
-    — called by the frontend's compute loop — runs one scheduling
-    round on the compute thread:
+    """Continuous batching over a paged :class:`GenerationRunner`
+    (module docstring).  ``submit`` enqueues from the router thread;
+    ``step`` — called by the frontend's compute loop — runs one
+    scheduling round on the compute thread:
 
       1. expire pending/active sequences past their deadline (partial
          tokens ship with the ``deadline`` policy reply);
-      2. migrate sequences whose fill reached their cache rung up one
-         rung (or force-finish ``truncated`` at the ladder top);
-      3. ONE decode tick: per cache rung, every live sequence's next
-         token in FIFO chunks of the top decode rung — finished
-         sequences release their slot mid-round;
-      4. ONE prefill batch: same-prompt-rung pending requests coalesce
-         (reaching past other rungs, like the 2-D drain) while slots
-         last — the prompt-side executable family, so a long prompt
-         costs ONE dispatch between decode ticks, never a stall of the
-         decode cadence.
+      2. admit pending requests into the ``slots`` concurrency bound —
+         admission runs the prefix-cache lookup, so a request whose
+         prompt shares indexed full pages starts with those pages
+         CLAIMED (read-only, refcounted) and only its tail to prefill;
+      3. ONE decode tick: every fully-prefilled sequence's next token,
+         grouped by page-table rung in FIFO chunks of the top decode
+         rung — finished sequences release their pages mid-round, and
+         a sequence at the context window force-finishes ``truncated``;
+      4. ONE prefill chunk batch: up to a prefill rung of
+         still-prefilling sequences each advance by ``prefill_chunk``
+         tokens — a long prompt costs one BOUNDED chunk between decode
+         ticks (chunked prefill), never a whole-prompt stall of the
+         decode cadence.  Page allocation (and copy-on-write of shared
+         pages about to be appended into) happens here on the host;
+         allocation pressure stalls a row for a tick, never the batch.
+
+    Device->host fetches follow ``on_device_sampling``: on, a tick
+    ships (b,) sampled tokens (plus logprobs when asked); off, it
+    ships (b, vocab) logits and samples on the host — same executable
+    family either way, and greedy tokens are bit-identical across the
+    knob.
 
     Returns the replies to ship: streamed per-token partials (opt-in)
     and whole-stream finals.  A resent ``generate`` request matching an
@@ -886,33 +924,38 @@ class GenerationScheduler:
         "gen_refused": "refused generate requests (policy in the reply)",
         "gen_dedup": "resent generate requests matched to an in-flight "
                      "generation (answered by the original)",
-        "prefill_batches": "prefill dispatches — the prompt side of the "
-                           "prefill/decode split",
-        "prefill_seqs": "sequences prefilled",
-        "prefill_tokens": "real prompt tokens prefilled",
+        "prefill_batches": "prefill chunk dispatches — the prompt side "
+                           "of the prefill/decode split",
+        "prefill_seqs": "sequences whose prefill completed",
+        "prefill_tokens": "prompt tokens actually COMPUTED by prefill "
+                          "chunks (prefix-cache hits skip theirs)",
         "decode_batches": "decode tick dispatches — the token side of "
                           "the prefill/decode split",
         "decode_tokens": "tokens emitted by decode ticks",
         "generated_tokens": "tokens emitted in total (prefill's first + "
                             "every decode)",
-        "migrations": "cache pages migrated up a rung (fill outgrew "
-                      "the rung)",
+        "cow_copies": "shared prefix pages copy-on-written at the "
+                      "first divergent append",
+        "fetch_bytes": "bytes fetched device->host by generation ticks "
+                       "(tokens or logits — the on-device-sampling "
+                       "lever)",
         "gen_finished": "generations completed to max_new_tokens",
-        "gen_truncated": "generations force-finished at the cache "
-                         "ladder / position table top",
+        "gen_truncated": "generations force-finished at the context "
+                         "window",
         "gen_timed_out": "generations abandoned at their deadline "
                          "(partial tokens shipped)",
     }
 
     def __init__(self, gen_runner, max_new_cap: int = 256,
                  pending_bound: int = 64, decode_tick_ms: float = 0.0,
-                 replica_id: str = ""):
+                 on_device_sampling: bool = True, replica_id: str = ""):
         from znicz_tpu import telemetry
 
         self.gen = gen_runner
         self.max_new_cap = int(max_new_cap)
         self.pending_bound = int(pending_bound)
         self.decode_tick_s = float(decode_tick_ms) / 1e3
+        self.on_device = bool(on_device_sampling)
         self.replica_id = replica_id
         self._lock = threading.Lock()
         self._pending: collections.deque = collections.deque()
@@ -929,31 +972,26 @@ class GenerationScheduler:
             "inter_token_seconds",
             "gap between consecutive emitted tokens of one sequence",
             size=8192)
-        _sc.gauge("kv_occupancy", "active KV slots / total slots",
+        _sc.gauge("kv_occupancy", "allocated KV pages / pool pages",
                   fn=telemetry.weak_fn(self, lambda s: s.gen.occupancy()))
-        _sc.gauge("active", "generations holding a KV slot",
+        _sc.gauge("active", "generations holding KV pages",
                   fn=telemetry.weak_fn(self, lambda s: len(s._active)))
-        _sc.gauge("pending", "generations queued for prefill",
+        _sc.gauge("pending", "generations queued for admission",
                   fn=telemetry.weak_fn(self, lambda s: len(s._pending)))
 
     # -- producer side (router thread) -----------------------------------------
-
-    def _prompt_rung(self, n: int) -> Optional[int]:
-        for r in self.gen.prompt_rungs:
-            if n <= r:
-                return r
-        return None
 
     def submit(self, seq: GenSeq) -> Optional[Refusal]:
         """Queue one generation, or refuse readably.  A resend of an
         in-flight (client, req_id) is absorbed (None — the original
         generation answers it)."""
-        if seq.prompt_len < 1 or self._prompt_rung(seq.prompt_len) is None:
+        if seq.prompt_len < 1 or seq.prompt_len > self.gen.max_ctx:
             self._m["gen_refused"].inc()
             return Refusal(
                 "oversized",
-                f"prompt of {seq.prompt_len} tokens outside the prompt "
-                f"ladder (1..{self.gen.prompt_rungs[-1]})", scope="client")
+                f"prompt of {seq.prompt_len} tokens outside the "
+                f"context window (1..{self.gen.max_ctx})",
+                scope="client")
         if seq.max_new < 1 or seq.max_new > self.max_new_cap:
             self._m["gen_refused"].inc()
             return Refusal(
@@ -1002,31 +1040,38 @@ class GenerationScheduler:
         return bool(self._pending or self._active)
 
     def work_ready(self, now: Optional[float] = None) -> bool:
-        """True when step() would do compute RIGHT NOW (pending prefill,
-        or active sequences with the decode tick pacing window open) —
-        the compute loop's busy/idle poll hint."""
+        """True when step() would do compute RIGHT NOW (pending
+        admission, sequences mid-prefill, or the decode tick pacing
+        window open) — the compute loop's busy/idle poll hint."""
         if self._pending:
             return True
         if not self._active:
             return False
+        if any(s.prefilled < s.prompt_len for s in self._active):
+            return True
         now = time.perf_counter() if now is None else now
         return now >= self._next_tick
 
     def _retire(self, seq: GenSeq) -> None:
-        """Drop a sequence from the live sets (lock taken here; slot
+        """Drop a sequence from the live sets (lock taken here; page
         release is the caller's — compute thread owns the pool)."""
         with self._lock:
             if seq in self._active:
                 self._active.remove(seq)
             self._inflight.discard((seq.client, seq.req_id))
 
+    def _release(self, seq: GenSeq) -> None:
+        """Return every page reference the request holds — shared
+        prefix pages survive via the index's own refs."""
+        if seq.pages:
+            self.gen.release_pages(seq.pages)
+            seq.pages = []
+
     def _final(self, seq: GenSeq, replies, truncated: Optional[str] = None,
                counter: str = "gen_finished") -> None:
         import numpy as np
 
-        if seq.slot is not None:
-            self.gen.release(seq.rung, seq.slot)
-            seq.slot = None
+        self._release(seq)
         self._retire(seq)
         self._m[counter].inc()
         rep = {"ok": True, "req_id": seq.req_id,
@@ -1039,14 +1084,14 @@ class GenerationScheduler:
         if seq.logits is not None:
             rep["logits"] = (np.stack(seq.logits) if seq.logits
                              else np.zeros((0, 0), np.float32))
+        if seq.logprobs is not None:
+            rep["logprobs"] = np.asarray(seq.logprobs, np.float32)
         replies.append((seq.reply_to, rep))
 
     def _expire(self, seq: GenSeq, replies) -> None:
         import numpy as np
 
-        if seq.slot is not None:
-            self.gen.release(seq.rung, seq.slot)
-            seq.slot = None
+        self._release(seq)
         self._retire(seq)
         self._m["gen_timed_out"].inc()
         replies.append((seq.reply_to, {
@@ -1058,11 +1103,13 @@ class GenerationScheduler:
                      f"({len(seq.tokens)} of {seq.max_new} tokens "
                      "emitted — shipped partial)"}))
 
-    def _emit(self, seq: GenSeq, token: int, row, now: float,
+    def _emit(self, seq: GenSeq, token: int, row, logp, now: float,
               replies) -> None:
         seq.tokens.append(int(token))
         if seq.logits is not None:
             seq.logits.append(row.copy())
+        if seq.logprobs is not None:
+            seq.logprobs.append(logp)
         if seq.t_last is not None:
             self._m_inter_token.observe(now - seq.t_last)
         seq.t_last = now
@@ -1072,6 +1119,87 @@ class GenerationScheduler:
                 "ok": True, "partial": True, "req_id": seq.req_id,
                 "replica_id": self.replica_id, "token": int(token),
                 "i": len(seq.tokens) - 1, "trace_id": seq.trace_id}))
+
+    # -- page bookkeeping ------------------------------------------------------
+
+    def _page_writable(self, seq: GenSeq, idx: int) -> bool:
+        """Make page slot ``idx`` of the request's table privately
+        writable: allocate at the boundary, copy-on-write a shared
+        (refcount > 1) page.  False -> allocation pressure; the caller
+        stalls that row one tick (its claimed pages are kept and the
+        row retries next round)."""
+        if idx == len(seq.pages):
+            page = self.gen.alloc_page()
+            if page is None:
+                return False
+            seq.pages.append(page)
+            return True
+        page = seq.pages[idx]
+        if self.gen.page_ref[page] > 1:
+            fresh = self.gen.alloc_page()
+            if fresh is None:
+                return False
+            self.gen.copy_page(page, fresh)
+            self.gen.decref(page)
+            seq.pages[idx] = fresh
+            self._m["cow_copies"].inc()
+        return True
+
+    def _ensure_chunk(self, seq: GenSeq) -> bool:
+        """Make every page the next prefill chunk writes writable."""
+        ps = self.gen.page_size
+        t0 = seq.prefilled
+        end = min(t0 + self.gen.prefill_chunk, seq.prompt_len)
+        for idx in range(t0 // ps, -(-end // ps)):
+            if not self._page_writable(seq, idx):
+                return False
+        return True
+
+    # -- fetch policy ----------------------------------------------------------
+
+    def _fetch(self, chunk, out):
+        """Device->host transfer for one dispatch, per the
+        ``on_device_sampling`` knob: tokens (+ logprobs on request) on
+        the device path, full logits on the host path or when a row
+        asked for them.  ``fetch_bytes`` counts the PADDED transfer —
+        the wire cost, which is what the sampling fusion shrinks.
+        Returns host ``(tokens, logps, logits)`` sliced to real rows
+        (None where not fetched)."""
+        import numpy as np
+
+        tok_dev, logp_dev, logits_dev, _ = out
+        n = len(chunk)
+        need_logits = ((not self.on_device)
+                       or any(s.return_logits for s in chunk))
+        need_logp = (self.on_device
+                     and any(s.return_logprobs for s in chunk))
+        toks = logps = logits = None
+        if self.on_device:
+            full = np.asarray(tok_dev)
+            self._m["fetch_bytes"].inc(int(full.nbytes))
+            toks = full[:n]
+        if need_logp:
+            full = np.asarray(logp_dev)
+            self._m["fetch_bytes"].inc(int(full.nbytes))
+            logps = full[:n]
+        if need_logits:
+            full = np.asarray(logits_dev)
+            self._m["fetch_bytes"].inc(int(full.nbytes))
+            logits = full[:n]
+        return toks, logps, logits
+
+    def _emit_row(self, seq: GenSeq, i: int, fetched, now: float,
+                  replies) -> None:
+        """Emit one row of a fetched dispatch (sample on host if the
+        device tokens weren't shipped)."""
+        toks, logps, logits = fetched
+        row = None if logits is None else logits[i]
+        token = int(toks[i]) if toks is not None else seq.sample(row)
+        logp = None
+        if seq.return_logprobs:
+            logp = (float(logps[i]) if logps is not None
+                    else _host_logp(row, token))
+        self._emit(seq, token, row, logp, now, replies)
 
     def step(self):
         """One scheduling round (class docstring).  Returns ``(worked,
@@ -1092,128 +1220,135 @@ class GenerationScheduler:
                         if s.t_deadline is not None and now > s.t_deadline]
         for s in doomed_p + doomed_a:
             self._expire(s, replies)
-        # 2. migrations / ladder-top truncation, 3. one decode tick —
-        # DISPATCHED, not yet fetched
+        # 2. admission into the concurrency bound; the prefix lookup
+        # claims shared full pages (refcounted, read-only) so a hit
+        # request starts with only its tail to prefill
+        admitted: List[GenSeq] = []
+        with self._lock:
+            while (self._pending
+                   and len(self._active) + len(admitted) < self.gen.slots):
+                admitted.append(self._pending.popleft())
+            self._active.extend(admitted)
+        for seq in admitted:
+            if self.gen.prefix is not None:
+                pages, covered = self.gen.prefix.lookup(seq.prompt)
+                seq.pages = pages
+                # full coverage still recomputes the LAST prompt token
+                # (a 1-token chunk) — the sampled continuation needs
+                # that position's logits, and the write (not the
+                # content) is what diverges: it COWs the shared page
+                seq.prefilled = min(covered, seq.prompt_len - 1)
+        # 3. one decode tick over fully-prefilled sequences, grouped by
+        # page-table rung — DISPATCHED, not yet fetched
         chunks = []
         if self._active and now >= self._next_tick:
-            stalled = set()
-            for seq in list(self._active):
-                if seq.t < seq.rung:
-                    continue
-                dst = self.gen._rung_for(seq.t + 1)
-                if dst is None:
-                    self._final(seq, replies, truncated="cache ladder "
+            groups: Dict[int, List[GenSeq]] = {}
+            ticked = False
+            for seq in sorted([s for s in self._active
+                               if s.prefilled >= s.prompt_len],
+                              key=lambda s: s.order):
+                ticked = True
+                if seq.t >= self.gen.max_ctx:
+                    self._final(seq, replies, truncated="context window "
                                 "exhausted", counter="gen_truncated")
                     continue
-                slot = self.gen.alloc(dst)
-                if slot is None:
-                    stalled.add(id(seq))    # waits for a release
-                    continue
-                self.gen.migrate(seq.rung, seq.slot, dst, slot)
-                self.gen.release(seq.rung, seq.slot)
-                seq.rung, seq.slot = dst, slot
-                self._m["migrations"].inc()
-                worked = True
-            groups: Dict[int, List[GenSeq]] = {}
-            for seq in self._active:
-                if id(seq) not in stalled:
-                    groups.setdefault(seq.rung, []).append(seq)
+                if not self._page_writable(seq, seq.t
+                                           // self.gen.page_size):
+                    continue            # page pressure: stall a tick
+                groups.setdefault(
+                    self.gen._page_rung(max(len(seq.pages), 1)),
+                    []).append(seq)
             # dispatch EVERY chunk of the tick before fetching any:
             # chunk N's device compute overlaps chunk N-1's host-side
-            # sampling and reply shipping (decode_async contract)
+            # emit and reply shipping (decode_async contract)
             chunk_max = self.gen.decode_rungs[-1]
             for rung in sorted(groups):
-                grp = sorted(groups[rung], key=lambda s: s.order)
+                grp = groups[rung]
                 for lo in range(0, len(grp), chunk_max):
                     chunk = grp[lo:lo + chunk_max]
-                    dev, gen = self.gen.decode_async(
-                        rung, [s.slot for s in chunk],
+                    out = self.gen.decode_async(
+                        [s.pages for s in chunk],
                         [s.tokens[-1] for s in chunk],
-                        [s.t for s in chunk])
-                    chunks.append((chunk, dev, gen))
+                        [s.t for s in chunk],
+                        [s.temperature for s in chunk],
+                        [s.top_k for s in chunk],
+                        [s.seed_val for s in chunk])
+                    chunks.append((chunk, out))
                     self._m["decode_batches"].inc()
                     self._m["decode_tokens"].inc(len(chunk))
                     worked = True
-            if groups and self.decode_tick_s > 0:
+            if ticked and self.decode_tick_s > 0:
                 self._next_tick = now + self.decode_tick_s
-        # 4. one prefill batch: head's prompt rung, reach past others.
+        # 4. ONE prefill chunk batch: up to a prefill rung of
+        # still-prefilling sequences advance by one bounded chunk.
         # Dispatched BETWEEN the decode dispatches and their fetches —
-        # prompt compute overlaps this tick's decode sampling.  (Slots
-        # released by this tick's finishers become claimable next
-        # round; slots freed by phases 1-2 are already in the pool.)
+        # prompt compute overlaps this tick's decode emit.
         batch: List[GenSeq] = []
-        cache_rung = None
-        s_rung = None
-        with self._lock:
-            if self._pending:
-                head = self._pending[0]
-                s_rung = self._prompt_rung(head.prompt_len)
-                cache_rung = self.gen._rung_for(s_rung)
-                cap = self.gen.prefill_rungs[-1]
-                for seq in list(self._pending):
-                    if len(batch) >= cap:
-                        break
-                    if self._prompt_rung(seq.prompt_len) != s_rung:
-                        continue
-                    slot = self.gen.alloc(cache_rung)
-                    if slot is None:
-                        break               # pool full: head waits
-                    seq.rung, seq.slot = cache_rung, slot
-                    batch.append(seq)
-                for seq in batch:
-                    self._pending.remove(seq)
+        for seq in sorted([s for s in self._active
+                           if s.prefilled < s.prompt_len],
+                          key=lambda s: s.order):
+            if len(batch) >= self.gen.prefill_rungs[-1]:
+                break
+            if self._ensure_chunk(seq):
+                batch.append(seq)
         pf = None
+        t0s: List[int] = []
+        nn: List[int] = []
         if batch:
-            x = np.zeros((len(batch), s_rung), self.gen.runner.dtype)
-            lengths = np.ones((len(batch),), np.int32)
+            c = self.gen.prefill_chunk
+            x = np.zeros((len(batch), c), self.gen.runner.dtype)
             for i, seq in enumerate(batch):
-                x[i, :seq.prompt_len] = seq.prompt
-                lengths[i] = seq.prompt_len
-            pf = self.gen.prefill_async(x, lengths, cache_rung,
-                                        [s.slot for s in batch])
+                t0 = seq.prefilled
+                n_new = min(c, seq.prompt_len - t0)
+                x[i, :n_new] = seq.prompt[t0:t0 + n_new]
+                t0s.append(t0)
+                nn.append(n_new)
+            pf = self.gen.prefill_async(
+                x, t0s, nn, [s.pages for s in batch],
+                [s.temperature for s in batch],
+                [s.top_k for s in batch],
+                [s.seed_val for s in batch])
             self._m["prefill_batches"].inc()
-            self._m["prefill_seqs"].inc(len(batch))
-            self._m["prefill_tokens"].inc(int(lengths.sum()))
+            self._m["prefill_tokens"].inc(sum(nn))
             worked = True
         # fetch + emit: decode chunks first (oldest dispatches), then
-        # the prefill's first tokens
-        for chunk, dev, gen in chunks:
-            logits = np.asarray(dev)[:len(chunk)]
+        # the prefill batch's completions
+        for chunk, out in chunks:
+            fetched = self._fetch(chunk, out)
             t_emit = time.perf_counter()
             for i, seq in enumerate(chunk):
                 seq.t += 1
-                seq.gen = gen
-                self._emit(seq, seq.sample(logits[i]), logits[i],
-                           t_emit, replies)
+                seq.gen = out[3]
+                self._emit_row(seq, i, fetched, t_emit, replies)
                 if len(seq.tokens) >= seq.max_new:
                     self._final(seq, replies)
         if pf is not None:
-            logits = np.asarray(pf[0])[:len(batch)]
-            gen = pf[1]
+            fetched = self._fetch(batch, pf)
             t_emit = time.perf_counter()
-            with self._lock:
-                self._active.extend(batch)
             for i, seq in enumerate(batch):
+                seq.prefilled = t0s[i] + nn[i]
+                if seq.prefilled < seq.prompt_len:
+                    continue        # mid-prompt chunk: sample discarded
                 seq.t = seq.prompt_len
-                seq.gen = gen
-                self._emit(seq, seq.sample(logits[i]), logits[i],
-                           t_emit, replies)
+                seq.gen = pf[3]
+                if self.gen.prefix is not None:
+                    self.gen.prefix.register(seq.prompt, seq.pages)
+                self._m["prefill_seqs"].inc()
+                self._emit_row(seq, i, fetched, t_emit, replies)
                 if len(seq.tokens) >= seq.max_new:
                     self._final(seq, replies)
         return worked, replies
 
     def drain(self) -> List:
         """Abandon every queued/live generation (service shutdown):
-        readable ``draining`` replies for all, slots released."""
+        readable ``draining`` replies for all, pages released."""
         replies: List = []
         with self._lock:
             pending = list(self._pending)
             self._pending.clear()
             active = list(self._active)
         for seq in pending + active:
-            if seq.slot is not None:
-                self.gen.release(seq.rung, seq.slot)
-                seq.slot = None
+            self._release(seq)
             self._retire(seq)
             self._m["gen_refused"].inc()
             replies.append((seq.reply_to, {
@@ -1244,12 +1379,15 @@ class GenerationScheduler:
         out = {"pending": pending, "active": active,
                "max_new_tokens": self.max_new_cap,
                "pending_bound": self.pending_bound,
-               "decode_tick_ms": self.decode_tick_s * 1e3}
+               "decode_tick_ms": self.decode_tick_s * 1e3,
+               "on_device_sampling": self.on_device}
         out.update({name: self._m[name].value for name in self.COUNTERS})
         out.update(self.inter_token_quantiles())
         out.update({k: v for k, v in self.gen.stats().items()
                     if k != "jit_cache_size"})
         return out
+
+
 
 
 # historical counter attributes, generated from COUNTERS (name + HELP

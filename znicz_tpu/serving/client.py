@@ -457,15 +457,19 @@ class InferenceClient:
                         temperature: float = 0.0, top_k: int = 0,
                         seed: Optional[int] = None, stream: bool = False,
                         return_logits: bool = False,
+                        return_logprobs: bool = False,
                         deadline_s: Optional[float] = None,
                         on_token=None) -> int:
         """Send one ``generate`` request (pipelined form); returns its
         ``req_id``.  With ``stream=True`` the service ships every
         decoded token as it lands and ``on_token(token, i)`` fires from
         whichever pump happens to be draining — the final reply (the
-        whole token array) still arrives through ``result()``.  Ship a
+        whole token array) still arrives through ``result()``.
+        ``return_logprobs`` asks for each emitted token's log-
+        probability (a (max_new_tokens,) float32 array in the final
+        reply — token-sized, unlike ``return_logits``).  Ship a
         ``seed`` with ``temperature > 0`` if a resend must reproduce
-        the same stream (sampling is host-side and seeded)."""
+        the same stream (sampling is seeded per sequence)."""
         self._breaker_admit()
         msg = {"cmd": "generate",
                "x": np.ascontiguousarray(np.asarray(prompt).reshape(-1)),
@@ -480,6 +484,8 @@ class InferenceClient:
             msg["stream"] = True
         if return_logits:
             msg["return_logits"] = True
+        if return_logprobs:
+            msg["return_logprobs"] = True
         budget = self.deadline_s if deadline_s is None else float(deadline_s)
         if budget > 0:
             msg["deadline_ms"] = budget * 1e3
@@ -497,18 +503,20 @@ class InferenceClient:
                  temperature: float = 0.0, top_k: int = 0,
                  seed: Optional[int] = None, stream: bool = False,
                  return_logits: bool = False,
+                 return_logprobs: bool = False,
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None, on_token=None) -> dict:
         """One generation, synchronously: the final reply dict —
         ``tokens`` (the (max_new_tokens,) int32 stream), ``gen`` (the
-        snapshot generation that produced them), ``prompt_len``, and
-        ``logits`` when requested.  Size ``timeout`` to the whole
-        generation, not one token."""
+        snapshot generation that produced them), ``prompt_len``, plus
+        ``logits`` / ``logprobs`` when requested.  Size ``timeout`` to
+        the whole generation, not one token."""
         return self.result(
             self.submit_generate(prompt, max_new_tokens,
                                  temperature=temperature, top_k=top_k,
                                  seed=seed, stream=stream,
                                  return_logits=return_logits,
+                                 return_logprobs=return_logprobs,
                                  deadline_s=deadline_s,
                                  on_token=on_token),
             timeout=timeout)

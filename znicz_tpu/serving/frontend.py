@@ -70,18 +70,28 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             # Importing a sequence sample (charlm) defaults max_len to
             # its trained window.
             "seq": {"max_len": 0, "rungs": None},
-            # generation serving (ISSUE 16): prefill/decode split over
-            # a bucketed KV-cache pool with continuous batching.  Off
-            # by default — scoring-only services pay nothing.  With
-            # enabled=True (needs the seq plane for the prompt ladder):
-            # ``max_new_tokens`` caps any one request's decode budget,
-            # ``cache_rungs`` overrides the power-of-two KV cache-length
-            # ladder (default: powers of two up to seq max_len),
-            # ``slots`` bounds concurrent generations per cache rung,
-            # ``decode_tick_ms`` paces the decode cadence (0 = free-
-            # running), ``pending_bound`` sheds prompt arrivals past it
+            # generation serving (ISSUE 16, paged in ISSUE 19):
+            # prefill/decode split over a block-paged KV pool with
+            # continuous batching, prefix reuse, and fused sampling.
+            # Off by default — scoring-only services pay nothing.
+            # With enabled=True: ``max_new_tokens`` caps any one
+            # request's decode budget, ``page_size`` sets the KV page
+            # (tokens per page — the sharing/COW granularity),
+            # ``num_pages`` sizes the pool (0 = auto: slots x pages
+            # per full context), ``prefill_chunk`` bounds the prompt
+            # tokens one tick may prefill per request (the inter-token
+            # p99 shield; defaults to page_size, which also makes
+            # prefix hits bit-exact vs cold prefills),
+            # ``prefix_cache`` arms content-addressed prefix-page
+            # sharing, ``on_device_sampling`` ships (b,) sampled
+            # tokens per tick instead of (b, vocab) logits, ``slots``
+            # bounds concurrent generations, ``decode_tick_ms`` paces
+            # the decode cadence (0 = free-running), and
+            # ``pending_bound`` sheds prompt arrivals past it
             "generate": {"enabled": False, "max_new_tokens": 256,
-                         "cache_rungs": None, "slots": 8,
+                         "page_size": 16, "num_pages": 0,
+                         "prefill_chunk": 0, "prefix_cache": True,
+                         "on_device_sampling": True, "slots": 8,
                          "decode_tick_ms": 0.0, "pending_bound": 64},
             # serving mesh (ISSUE 13; serving/model.py reads it through
             # a local alias): NamedSharding axis sizes — requests split
@@ -267,10 +277,12 @@ class InferenceServer:
             ladder=ladder,
             admission=admission or _admission_from_config())
         self.request_ttl_s = float(_cfg("request_ttl_s", request_ttl_s))
-        # generation serving (ISSUE 16; knobs read through a local
-        # alias like the admission subtree): a GenerationRunner (KV-
-        # cache pool + prefill/decode executables) under a continuous-
-        # batching scheduler, driven by the SAME compute thread
+        # generation serving (ISSUE 16, paged in ISSUE 19; knobs read
+        # through a local alias like the admission subtree): a paged
+        # GenerationRunner (block-paged KV pool + prefix cache +
+        # chunked-prefill/decode executables with fused sampling)
+        # under a continuous-batching scheduler, driven by the SAME
+        # compute thread
         d_gen = DEFAULTS["generate"]
         gn = root.common.serving.generate
         self.gen_sched: Optional[GenerationScheduler] = None
@@ -278,21 +290,27 @@ class InferenceServer:
             if self.seq_max_len is None:
                 raise ValueError(
                     "generation serving rides the variable-length "
-                    "plane (the prompt ladder IS the seq ladder) — "
+                    "plane (the context window IS the seq window) — "
                     "set root.common.serving.seq.max_len alongside "
                     "root.common.serving.generate.enabled")
-            rungs = gn.get("cache_rungs", d_gen["cache_rungs"])
-            if rungs is None:
-                # power-of-two cache-length ladder up to the serving
-                # window — the zero-recompile contract's rung set
-                top = self.seq_max_len
-                rungs = [r for r in (8, 16, 32, 64, 128, 256, 512,
-                                     1024, 2048, 4096) if r < top]
-                rungs.append(top)
+            page_size = int(gn.get("page_size", d_gen["page_size"]))
+            slots = int(gn.get("slots", d_gen["slots"]))
+            num_pages = int(gn.get("num_pages", d_gen["num_pages"]))
+            if num_pages <= 0:
+                # auto pool: every slot can hold one full context —
+                # admission (slots) and allocation can't deadlock
+                num_pages = slots * (-(-self.seq_max_len // page_size))
+            chunk = int(gn.get("prefill_chunk", d_gen["prefill_chunk"]))
+            if chunk <= 0:
+                # chunk == page_size keeps prefill grids aligned with
+                # page boundaries — prefix hits replay the exact
+                # executables a cold prefill runs (bit-exact reuse)
+                chunk = page_size
             gr = self.runner.enable_generation(
-                cache_rungs=[int(r) for r in rungs],
-                slots=int(gn.get("slots", d_gen["slots"])),
-                prompt_rungs=list(self.batcher.ladder.seq_rungs))
+                page_size=page_size, num_pages=num_pages, slots=slots,
+                prefill_chunk=chunk,
+                prefix_cache=bool(gn.get("prefix_cache",
+                                         d_gen["prefix_cache"])))
             self.gen_sched = GenerationScheduler(
                 gr,
                 max_new_cap=int(gn.get("max_new_tokens",
@@ -301,6 +319,9 @@ class InferenceServer:
                                          d_gen["pending_bound"])),
                 decode_tick_ms=float(gn.get("decode_tick_ms",
                                             d_gen["decode_tick_ms"])),
+                on_device_sampling=bool(
+                    gn.get("on_device_sampling",
+                           d_gen["on_device_sampling"])),
                 replica_id=self.replica_id)
         self.max_requests = max_requests
         self._warmup = warmup
@@ -927,6 +948,7 @@ class InferenceServer:
                 seed=req.get("seed"),
                 stream=bool(req.get("stream", False)),
                 return_logits=bool(req.get("return_logits", False)),
+                return_logprobs=bool(req.get("return_logprobs", False)),
                 reply_to=list(envelope), req_id=rid,
                 trace_id=req.get("trace_id"),
                 client=client,

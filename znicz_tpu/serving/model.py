@@ -35,12 +35,14 @@ tuple exactly once, every request is answered entirely by one snapshot
 generation (the ``gen`` id in each reply proves it).  A failed load or
 warm leaves the served generation untouched.
 
-**Autoregressive generation** (ISSUE 16): :meth:`enable_generation`
-builds a :class:`GenerationRunner` — a bucketed KV-cache pool plus
-three more jitted functions (prefill, decode, migrate) that share the
-runner's ``compiles`` counter, so the zero-recompile contract extends
-over the whole generation executable family: ``prefill_rungs x
-prompt_rungs + decode_rungs x cache_rungs + (cache_rungs - 1)``
+**Autoregressive generation** (ISSUE 16, block-paged since ISSUE 19):
+:meth:`enable_generation` builds a :class:`GenerationRunner` — a
+block-paged KV pool with content-addressed prefix reuse
+(:class:`PrefixCache`) plus three more jitted functions
+(prefill-chunk, decode, page-copy; greedy/top-k sampling fused into
+the first two) that share the runner's ``compiles`` counter, so the
+zero-recompile contract extends over the whole generation executable
+family: ``(prefill_rungs + decode_rungs) x page_rungs + 1``
 executables, warmed up front, zero traces after.
 
 **Pod-scale sharding** (ISSUE 13): with ``root.common.serving.mesh.*``
@@ -487,17 +489,21 @@ class ModelRunner:
         finally:
             self._swap_lock.release()
 
-    def enable_generation(self, cache_rungs, slots: int, prompt_rungs,
+    def enable_generation(self, page_size: int, num_pages: int,
+                          slots: int, prefill_chunk: int,
+                          prefix_cache: bool = True,
                           prefill_rungs=None, decode_rungs=None
                           ) -> "GenerationRunner":
-        """Build the autoregressive generation path (ISSUE 16): a
-        bucketed KV-cache pool plus jitted prefill/decode/migrate
-        functions over this runner's live params.  Idempotent per
-        runner; returns the :class:`GenerationRunner`."""
+        """Build the autoregressive generation path (ISSUE 16, paged
+        since ISSUE 19): a block-paged KV pool with prefix reuse plus
+        jitted prefill-chunk/decode/copy functions (sampling fused)
+        over this runner's live params.  Idempotent per runner; returns
+        the :class:`GenerationRunner`."""
         if self.gen_runner is None:
             self.gen_runner = GenerationRunner(
-                self, cache_rungs=cache_rungs, slots=slots,
-                prompt_rungs=prompt_rungs, prefill_rungs=prefill_rungs,
+                self, page_size=page_size, num_pages=num_pages,
+                slots=slots, prefill_chunk=prefill_chunk,
+                prefix_cache=prefix_cache, prefill_rungs=prefill_rungs,
                 decode_rungs=decode_rungs)
         return self.gen_runner
 
@@ -670,53 +676,197 @@ def batch_rungs(max_batch: int) -> Tuple[int, ...]:
     return tuple(rungs)
 
 
-class GenerationRunner:
-    """The autoregressive generation compute plane (ISSUE 16): a
-    bucketed KV-cache pool + three jitted functions over the owning
-    :class:`ModelRunner`'s live params.
+def _sample_tokens(logits, temp, top_k, seeds, t):
+    """Fused in-graph sampling (ISSUE 19): greedy argmax where
+    ``temp <= 0`` (tie -> lowest id, matching the host sampler bit for
+    bit), else seeded gumbel-max over the optional per-row top-k cut.
+    ``seeds`` is (b,) uint32; each row's key is
+    ``fold_in(PRNGKey(seed), t)`` — deterministic per (request seed,
+    position), independent of co-batched neighbors and batch padding.
+    Returns ((b,) int32 tokens, (b,) f32 logprob of the chosen token
+    under the raw logits)."""
+    import jax
+    import jax.numpy as jnp
 
-    **Pool**: per cache rung ``C`` (power-of-two lengths), per attention
-    layer, one ``(slots + 1, C, heads, head_dim)`` device array for keys
-    and one for values.  A slot is one request's cache page; the extra
-    slot (index ``slots``) is SCRATCH — padded batch rows gather from
-    and scatter into it, so a pad row can never touch a real request's
-    page and every real row stays a pure function of its own page (the
-    per-decoded-token bit-exactness contract rides on this).  A request
-    whose fill reaches its rung migrates up one rung (a jitted prefix
-    copy); a finished request's slot returns to the free list
-    immediately.
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    z = logits / jnp.maximum(temp, 1e-20)[:, None]
+    srt = jnp.sort(z, axis=-1)                         # ascending
+    kk = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v)
+    kth = srt[jnp.arange(b), v - kk]                   # kth-largest
+    z = jnp.where(z < kth[:, None], -jnp.inf, z)
+
+    def noise(seed, pos):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        return jax.random.gumbel(key, (v,), jnp.float32)
+
+    sampled = jnp.argmax(z + jax.vmap(noise)(seeds, t),
+                         axis=-1).astype(jnp.int32)
+    tok = jnp.where(temp > 0, sampled, greedy)
+    logp = jax.nn.log_softmax(logits, axis=-1)[jnp.arange(b), tok]
+    return tok, logp
+
+
+class PrefixCache:
+    """Page-granularity content-addressed prefix index (ISSUE 19).
+
+    Pages are keyed by a CHAIN hash: page ``i`` of a prompt hashes
+    (hash of pages ``[0..i)``, tokens ``[i*ps .. (i+1)*ps)``), so a
+    lookup can only match a page whose ENTIRE preceding context matches
+    too — content addressing over the prefix, not the page in
+    isolation.  The index holds one refcount on every registered page;
+    requests that hit share the page READ-ONLY (refcount++), and the
+    first divergent append copy-on-writes (scheduler-driven, via
+    :meth:`GenerationRunner.copy_page`).  Eviction is LRU over entries
+    nobody but the index holds (refcount == 1) and runs only under
+    allocation pressure — a cached page costs nothing until the pool
+    actually wants it back.
+
+    Bit-exactness: a hit replays k/v that the SAME prefill executable
+    grid computed (registration indexes only canonically-computed
+    pages — a COW'd recompute page is skipped because its hash is
+    already indexed), so with ``prefill_chunk == page_size`` a
+    prefix-hit generation decodes bit-identically to a cold one."""
+
+    def __init__(self, gen: "GenerationRunner"):
+        from collections import OrderedDict
+
+        self.gen = gen
+        #: chain-hash -> page id, in LRU order (move_to_end on hit)
+        self._index = OrderedDict()
+        self._by_page: Dict[int, bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _hashes(self, prompt):
+        """Chain hashes of every FULL page of ``prompt``."""
+        import hashlib
+
+        ps = self.gen.page_size
+        out = []
+        h = b"znicz-prefix-v1"
+        for i in range(len(prompt) // ps):
+            h = hashlib.blake2b(
+                h + np.asarray(prompt[i * ps:(i + 1) * ps],
+                               np.int32).tobytes(),
+                digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def lookup(self, prompt):
+        """Claim the longest indexed run of ``prompt``'s full pages:
+        returns ``(pages, covered_tokens)`` with one reference taken on
+        each matched page (the request's own; drop via
+        ``release_pages``)."""
+        pages = []
+        for h in self._hashes(prompt):
+            page = self._index.get(h)
+            if page is None:
+                break
+            self._index.move_to_end(h)
+            self.gen.addref(page)
+            pages.append(page)
+        covered = len(pages) * self.gen.page_size
+        m = self.gen._pm
+        if pages:
+            m["hits"].inc()
+            m["tokens_avoided"].inc(covered)
+            m["flops_avoided"].inc(covered * self.gen.flops_per_token)
+        else:
+            m["misses"].inc()
+        return pages, covered
+
+    def register(self, prompt, pages) -> None:
+        """Index ``prompt``'s full pages once its prefill completed.
+        Already-indexed hashes keep their existing page (first writer
+        wins); fresh ones take one index-owned reference on the
+        request's page."""
+        for i, h in enumerate(self._hashes(prompt)):
+            if h in self._index or pages[i] in self._by_page:
+                continue
+            self.gen.addref(pages[i])
+            self._index[h] = pages[i]
+            self._by_page[pages[i]] = h
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used entry whose page only the index
+        holds (refcount == 1) — frees exactly one page.  False when
+        every indexed page is currently shared with a live request."""
+        for h, page in self._index.items():
+            if self.gen.page_ref[page] == 1:
+                del self._index[h]
+                del self._by_page[page]
+                self.gen.decref(page)
+                self.gen._pm["evictions"].inc()
+                return True
+        return False
+
+
+class GenerationRunner:
+    """The autoregressive generation compute plane (ISSUE 16), block-
+    paged with prefix reuse and fused sampling (ISSUE 19).
+
+    **Pool**: per attention layer, ONE ``(num_pages + 1, page_size,
+    heads, head_dim)`` device array for keys and one for values —
+    committed at creation (an uncommitted first-call pool leaves a
+    stale lowering per shape that jax silently re-lowers under
+    steady-state traffic).  Page index ``num_pages`` is SCRATCH — pad
+    batch rows gather from and scatter into it, so a pad row can never
+    touch a real request's page and every real row stays a pure
+    function of its own pages (the per-decoded-token bit-exactness
+    contract rides on this).  A request's cache is a host-side page
+    list; dispatches carry it as a (batch, P) int32 page table padded
+    to power-of-two page-count rungs ``P``.  Growing a request's cache
+    is a host-side list append — the old per-rung slot pools and the
+    rung-migration executable family are gone entirely; one whole-page
+    COPY executable remains, for copy-on-write.
+
+    **Prefix reuse**: :class:`PrefixCache` — full pages are content-
+    addressed by a chain hash of the tokens they hold, shared read-only
+    across requests via refcount, copy-on-write on the first divergent
+    append.
 
     **Executables** (all tick the owning runner's ``compiles`` counter,
     so the serving gates' zero-recompile proof covers generation):
 
-      - prefill: one per (prefill batch rung x prompt seq rung) — runs
-        the full forward over the prompt bucket, scatters every
-        attention layer's k/v into the slots, returns each row's logits
-        at its LAST REAL position (``lengths - 1``);
-      - decode: one per (decode batch rung x cache rung) — gathers the
+      - prefill: one per (prefill batch rung x page rung) — runs the
+        forward over ONE fixed-width ``prefill_chunk`` token chunk at
+        per-row global offsets ``t0`` (long prompts prefill across
+        ticks, the cache carried by the page table — chunked prefill
+        bounds the work any single tick can absorb), scatters the
+        chunk's k/v into the pool (pad tokens -> scratch), and samples
+        each row's next token at its last real position in-graph;
+      - decode: one per (decode batch rung x page rung) — gathers the
         co-batched requests' pages, appends this step's k/v row at each
         row's own depth ``t``, attends the length-1 query over
-        ``[0..t]``, scatters ONLY the new row back, returns (rows,
-        vocab) logits.  O(C) per token vs the re-prefill oracle's
-        O(S^2);
-      - migrate: one per adjacent cache-rung pair — prefix copy of one
-        slot's page into a fresh slot a rung up.
+        ``[0..t]``, samples in-graph.  O(t) per token;
+      - copy: whole-page copy (src -> dst), the COW move.
 
-    Single-device only (the serving mesh and generation compose later);
-    compute calls are serialized by the frontend's compute thread —
-    alloc/release/migrate bookkeeping is not locked, by that contract.
+    Sampling is FUSED into both compute executables — they return
+    ``(tokens, logprobs, logits, pools)`` and transfers happen per
+    FETCHED array, so the scheduler's on-device-sampling mode ships
+    (b,) int32 tokens instead of (b, vocab) logits per tick.  The
+    executable family is one and the same either way, which makes
+    greedy bit-identity across the knob free and keeps
+    ``return_logits`` costless until requested.
 
-    Sampling is the CALLER's (the scheduler samples on host — logits
-    must materialize per tick anyway to pick the next token), which
-    keeps this class a pure compute surface."""
+    Single-device only (the serving mesh and generation compose
+    later); compute calls are serialized by the frontend's compute
+    thread — page bookkeeping is not locked, by that contract."""
 
-    def __init__(self, runner: ModelRunner, cache_rungs, slots: int,
-                 prompt_rungs, prefill_rungs=None, decode_rungs=None):
+    def __init__(self, runner: ModelRunner, page_size: int,
+                 num_pages: int, slots: int, prefill_chunk: int,
+                 prefix_cache: bool = True, prefill_rungs=None,
+                 decode_rungs=None):
         import jax
         import jax.numpy as jnp
 
+        from znicz_tpu import telemetry
         from znicz_tpu.attention import (CharEmbedding, MultiHeadAttention,
                                          SeqAll2All)
+        from znicz_tpu.ops.attention import paged_append, paged_gather
         from znicz_tpu.ops.linear import seq_linear
 
         if runner.mesh is not None:
@@ -755,60 +905,112 @@ class GenerationRunner:
             raise ValueError("generation serving needs at least one "
                              "MultiHeadAttention unit (nothing to cache)")
         self.max_len = int(forwards[0].max_len)
-        rungs = tuple(sorted({int(r) for r in cache_rungs}))
-        if not rungs or rungs[0] < 2:
-            raise ValueError(f"cache rungs must be >= 2, got {rungs}")
-        if rungs[-1] > self.max_len:
+        self.page_size = int(page_size)
+        if self.page_size < 2:
+            raise ValueError(f"page_size must be >= 2, got {page_size}")
+        self.num_pages = int(num_pages)
+        pages_per_seq = -(-self.max_len // self.page_size)
+        if self.num_pages < pages_per_seq:
             raise ValueError(
-                f"cache rung {rungs[-1]} exceeds the positional "
-                f"table's max_len={self.max_len}")
-        self.cache_rungs = rungs
+                f"num_pages={num_pages} cannot hold one full context "
+                f"window ({pages_per_seq} pages of {self.page_size} "
+                f"for max_len={self.max_len})")
+        #: scratch page index — pad rows' page; never allocated
+        self.scratch = self.num_pages
+        rungs = []
+        r = 1
+        while r < pages_per_seq:
+            rungs.append(r)
+            r *= 2
+        rungs.append(r)
+        #: page-table width rungs: powers of two up to a full context's
+        #: page count — the executable family's second axis
+        self.page_rungs = tuple(rungs)
+        #: the context window: positions ``[0 .. max_ctx)`` are the most
+        #: any one request (prompt + generated) may occupy
+        self.max_ctx = self.max_len
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.slots = int(slots)
         if self.slots < 1:
-            raise ValueError("slot pool needs >= 1 slot per rung")
-        #: scratch slot index — pad rows' page; never allocated
-        self.scratch = self.slots
-        self.prompt_rungs = tuple(sorted({int(r) for r in prompt_rungs
-                                          if self._rung_for(int(r))}))
-        if not self.prompt_rungs:
-            raise ValueError(
-                f"no prompt rung fits the cache ladder {rungs}")
+            raise ValueError("generation needs >= 1 concurrency slot")
         self.prefill_rungs = tuple(prefill_rungs) if prefill_rungs \
             else batch_rungs(4)
         self.decode_rungs = tuple(decode_rungs) if decode_rungs \
             else batch_rungs(self.slots)
         shapes = {f.name: (f.heads, f.head_dim) for f in self._attn}
-        #: the pool: {rung: {layer: (slots+1, rung, heads, dim)}} x (k, v)
-        # commit the fresh pages to an explicit device: every later pool
+        #: the pool: {layer: (num_pages+1, page_size, heads, dim)} x (k, v)
+        # commit the fresh pools to an explicit device: every later pool
         # array is a COMMITTED donated jit output, and an uncommitted
-        # first-call pool would leave one stale lowering per pool rung
-        # that jax silently re-lowers (cache growth without a retrace)
-        # the first time steady-state traffic replays that shape
+        # first-call pool would leave one stale lowering that jax
+        # silently re-lowers (cache growth without a retrace) the first
+        # time steady-state traffic replays that shape
         dev = jax.local_devices()[0]
-        self.pk = {C: {n: jax.device_put(
-                           jnp.zeros((self.slots + 1, C, h, d),
-                                     jnp.float32), dev)
-                       for n, (h, d) in shapes.items()}
-                   for C in self.cache_rungs}
-        self.pv = {C: {n: jax.device_put(
-                           jnp.zeros((self.slots + 1, C, h, d),
-                                     jnp.float32), dev)
-                       for n, (h, d) in shapes.items()}
-                   for C in self.cache_rungs}
-        self._free = {C: list(range(self.slots)) for C in self.cache_rungs}
+        self.pk = {n: jax.device_put(
+                       jnp.zeros((self.num_pages + 1, self.page_size,
+                                  h, d), jnp.float32), dev)
+                   for n, (h, d) in shapes.items()}
+        self.pv = {n: jax.device_put(
+                       jnp.zeros((self.num_pages + 1, self.page_size,
+                                  h, d), jnp.float32), dev)
+                   for n, (h, d) in shapes.items()}
+        #: host-side page allocator (compute-thread only, like the old
+        #: slot free lists): free stack + per-page refcounts
+        self._free_pages = list(range(self.num_pages))
+        self.page_ref = np.zeros(self.num_pages, np.int32)
+        #: ~2 flops per weight per token — the prefill-FLOPs-avoided
+        #: counter's conversion rate
+        self.flops_per_token = 2 * sum(
+            int(arr.mem.size) for f in forwards
+            for arr in f.params().values() if arr.mem is not None)
+        _pc = telemetry.scope("prefix_cache")
+        self._pm = {
+            "hits": _pc.counter(
+                "hits", "prompt prefix lookups that matched (>= 1 "
+                "full page shared)"),
+            "misses": _pc.counter(
+                "misses", "prompt prefix lookups that matched nothing"),
+            "evictions": _pc.counter(
+                "evictions", "indexed prefix pages evicted under "
+                "allocation pressure (LRU, idle entries only)"),
+            "tokens_avoided": _pc.counter(
+                "tokens_avoided", "prompt tokens NOT prefilled thanks "
+                "to prefix-page hits"),
+            "flops_avoided": _pc.counter(
+                "flops_avoided", "prefill flops avoided by prefix "
+                "reuse (tokens_avoided x ~2 flops/weight)"),
+        }
+        _pc.gauge("indexed_pages", "pages held by the prefix index",
+                  fn=telemetry.weak_fn(
+                      self, lambda s: float(len(s.prefix))
+                      if s.prefix is not None else 0.0))
+        _pc.gauge("shared_pages", "pages referenced by > 1 holder",
+                  fn=telemetry.weak_fn(
+                      self, lambda s: float((s.page_ref > 1).sum())))
+        _pc.gauge("page_occupancy", "allocated pages / pool pages",
+                  fn=telemetry.weak_fn(self, lambda s: s.occupancy()))
+        self.prefix = PrefixCache(self) if prefix_cache else None
         compiles = runner._m["compiles"]
         seq_softmax = tr._seq_softmax_cls
         dropout = tr._dropout_cls
+        n_pages, psz = self.num_pages, self.page_size
 
-        def run_prefill(params, pk, pv, x, lengths, slot_idx):
+        def run_prefill(params, pk, pv, table, x, t0, n_new,
+                        temp, top_k, seeds):
             compiles.inc()      # znicz: ignore[jit-purity] — trace tick
-            h = tr._decode(x)
+            toks = tr._decode(x)
+            h = None
             rows = {}
             for f in forwards:
                 p = params.get(f.name, {})
-                if isinstance(f, MultiHeadAttention):
-                    h, k_seg, v_seg = f.apply_prefill(p, h)
-                    rows[f.name] = (k_seg, v_seg)
+                if isinstance(f, CharEmbedding):
+                    h = f.apply_offset(p, toks, t0)
+                elif isinstance(f, MultiHeadAttention):
+                    h, k_rows, v_rows = f.apply_prefill_chunk(
+                        p, h, paged_gather(pk[f.name], table),
+                        paged_gather(pv[f.name], table), t0)
+                    rows[f.name] = (k_rows, v_rows)
                 elif f is last and isinstance(f, seq_softmax):
                     h = seq_linear(h, p["weights"], p.get("bias"),
                                    weights_transposed=f.weights_transposed)
@@ -816,25 +1018,38 @@ class GenerationRunner:
                     pass
                 else:
                     h = f.apply(p, h)
-            b, s = x.shape[:2]
-            logits = h[jnp.arange(b), lengths - 1]
-            pk = {n: pk[n].at[slot_idx, :s].set(rows[n][0]) for n in pk}
-            pv = {n: pv[n].at[slot_idx, :s].set(rows[n][1]) for n in pv}
-            return logits, pk, pv
+            b, c = x.shape[:2]
+            width = table.shape[1]
+            logits = h[jnp.arange(b), n_new - 1]
+            # persist the chunk's k/v: token j of row i lands on page
+            # table[i, (t0+j) // page_size] at offset (t0+j) %
+            # page_size; pad tokens (j >= n_new) land on scratch
+            pos = t0[:, None] + jnp.arange(c)
+            page = table[jnp.arange(b)[:, None],
+                         jnp.clip(pos // psz, 0, width - 1)]
+            page = jnp.where(jnp.arange(c)[None, :] < n_new[:, None],
+                             page, n_pages)
+            off = pos % psz
+            pk = {n: pk[n].at[page, off].set(rows[n][0]) for n in pk}
+            pv = {n: pv[n].at[page, off].set(rows[n][1]) for n in pv}
+            tok, logp = _sample_tokens(logits, temp, top_k, seeds,
+                                       t0 + n_new - 1)
+            return tok, logp, logits, pk, pv
 
-        def run_decode(params, pk, pv, slot_idx, tokens, t):
+        def run_decode(params, pk, pv, table, tokens, t,
+                       temp, top_k, seeds):
             compiles.inc()      # znicz: ignore[jit-purity] — trace tick
+            toks = tr._decode(tokens)
             h = None
             rows = {}
-            toks = tr._decode(tokens)
             for f in forwards:
                 p = params.get(f.name, {})
                 if isinstance(f, CharEmbedding):
                     h = f.apply_decode(p, toks, t)
                 elif isinstance(f, MultiHeadAttention):
                     h, k_row, v_row = f.apply_decode(
-                        p, h, pk[f.name][slot_idx], pv[f.name][slot_idx],
-                        t)
+                        p, h, paged_gather(pk[f.name], table),
+                        paged_gather(pv[f.name], table), t)
                     rows[f.name] = (k_row, v_row)
                 elif f is last and isinstance(f, seq_softmax):
                     h = seq_linear(h, p["weights"], p.get("bias"),
@@ -843,61 +1058,87 @@ class GenerationRunner:
                     pass
                 else:
                     h = f.apply(p, h)
-            pk = {n: pk[n].at[slot_idx, t].set(rows[n][0]) for n in pk}
-            pv = {n: pv[n].at[slot_idx, t].set(rows[n][1]) for n in pv}
-            return h[:, 0], pk, pv
+            logits = h[:, 0]
+            pk = {n: paged_append(pk[n], table, rows[n][0], t)
+                  for n in pk}
+            pv = {n: paged_append(pv[n], table, rows[n][1], t)
+                  for n in pv}
+            tok, logp = _sample_tokens(logits, temp, top_k, seeds, t)
+            return tok, logp, logits, pk, pv
 
-        def run_migrate(pk_src, pv_src, pk_dst, pv_dst, src, dst):
+        def run_copy(pk, pv, src, dst):
             compiles.inc()      # znicz: ignore[jit-purity] — trace tick
-            c = next(iter(pk_src.values())).shape[1]
-            pk_dst = {n: pk_dst[n].at[dst, :c].set(pk_src[n][src])
-                      for n in pk_dst}
-            pv_dst = {n: pv_dst[n].at[dst, :c].set(pv_src[n][src])
-                      for n in pv_dst}
-            return pk_dst, pv_dst
+            pk = {n: pk[n].at[dst].set(pk[n][src]) for n in pk}
+            pv = {n: pv[n].at[dst].set(pv[n][src]) for n in pv}
+            return pk, pv
 
         dn = runner.donate
         self._prefill = jax.jit(run_prefill,
                                 donate_argnums=(1, 2) if dn else ())
         self._decode = jax.jit(run_decode,
                                donate_argnums=(1, 2) if dn else ())
-        self._migrate = jax.jit(run_migrate,
-                                donate_argnums=(2, 3) if dn else ())
-        #: AOT dispatch table (ISSUE 17), keyed ("prefill", b, s, rung)
-        #: / ("decode", b, rung) / ("migrate", src, dst) — the same
-        #: rungs warmup() walks, so a cache-warm boot loads the whole
-        #: generation family through the owning runner's _aot_exec
+        self._copy = jax.jit(run_copy,
+                             donate_argnums=(0, 1) if dn else ())
+        #: AOT dispatch table (ISSUE 17), keyed ("prefill", b, P) /
+        #: ("decode", b, P) / ("copy",) — the same grid warmup() walks,
+        #: so a cache-warm boot loads the whole generation family
+        #: through the owning runner's _aot_exec
         self._aot: Dict = {}
 
-    # -- pool bookkeeping (compute-thread only) --------------------------------
+    # -- page bookkeeping (compute-thread only) --------------------------------
 
-    def _rung_for(self, length: int) -> Optional[int]:
-        """Smallest cache rung holding ``length`` positions, or None
-        when the ladder tops out below it."""
-        for c in self.cache_rungs:
-            if c >= length:
-                return c
-        return None
+    def _page_rung(self, n_pages: int) -> int:
+        """Smallest page-table width rung holding ``n_pages`` pages."""
+        for r in self.page_rungs:
+            if r >= n_pages:
+                return r
+        raise ValueError(
+            f"{n_pages} pages exceed the top rung "
+            f"{self.page_rungs[-1]} — the context window bounds this")
 
-    def alloc(self, rung: int) -> Optional[int]:
-        """Claim a free slot on ``rung`` (None = rung exhausted; the
-        scheduler queues until a release)."""
-        free = self._free[rung]
-        return free.pop() if free else None
+    def alloc_page(self) -> Optional[int]:
+        """Claim one free page (refcount 1).  Under pressure, evict an
+        idle prefix-index page LRU-first; None when every page is held
+        by a live request (the scheduler stalls that row a tick)."""
+        if not self._free_pages and self.prefix is not None:
+            self.prefix.evict_one()
+        if not self._free_pages:
+            return None
+        page = self._free_pages.pop()
+        self.page_ref[page] = 1
+        return page
 
-    def release(self, rung: int, slot: int) -> None:
-        """Return a finished/failed request's slot immediately — the
-        continuous-batching lever: the next prefill can claim it this
-        very tick."""
-        self._free[rung].append(slot)
+    def addref(self, page: int) -> None:
+        """One more holder of a shared (read-only) page."""
+        self.page_ref[page] += 1
 
-    def slots_active(self) -> int:
-        return sum(self.slots - len(f) for f in self._free.values())
+    def decref(self, page: int) -> None:
+        """Drop one reference; the page frees at zero."""
+        self.page_ref[page] -= 1
+        assert self.page_ref[page] >= 0, f"page {page} over-released"
+        if self.page_ref[page] == 0:
+            self._free_pages.append(page)
+
+    def release_pages(self, pages) -> None:
+        """Return a finished/failed request's page references
+        immediately — the continuous-batching lever: pages shared with
+        the prefix index or other requests survive via their remaining
+        refs; private ones are claimable this very tick."""
+        for page in pages:
+            self.decref(page)
+
+    def pages_active(self) -> int:
+        return self.num_pages - len(self._free_pages)
+
+    def pages_leaked(self) -> int:
+        """Invariant probe (must be 0): pages neither free nor
+        referenced are lost to the allocator forever."""
+        return int(self.num_pages - len(self._free_pages)
+                   - int((self.page_ref > 0).sum()))
 
     def occupancy(self) -> float:
-        """Active slots / total slots, the KV-pool pressure gauge."""
-        return self.slots_active() / float(self.slots
-                                           * len(self.cache_rungs))
+        """Allocated pages / pool pages, the KV-pool pressure gauge."""
+        return self.pages_active() / float(self.num_pages)
 
     # -- compute (compute-thread only) -----------------------------------------
 
@@ -911,117 +1152,150 @@ class GenerationRunner:
     def _run_jit(self, key, jitfn, args):
         """One generation dispatch: plain jit call until the owning
         runner armed its AOT cache, the shared AOT table after.  The
-        key's ints are both the table key and the cache entry — the
-        rung grid is identical between warmup and traffic (prefill's
-        cache rung is ``_rung_for(prompt rung)`` on both sides), so
-        every traffic shape resolves to a warmed entry."""
+        key's ints are both the table key and the cache entry; the
+        entry also carries the paged geometry, so cache entries from a
+        differently-paged boot can never collide."""
         r = self.runner
         if r._aot_cache is None:
             return jitfn(*args)
-        entry = {"kind": key[0], "key": [int(k) for k in key[1:]]}
+        entry = {"kind": key[0], "key": [int(k) for k in key[1:]],
+                 "paged": [self.page_size, self.num_pages,
+                           self.prefill_chunk]}
         return r._aot_exec(self._aot, key, entry, jitfn, args)
 
-    def prefill_async(self, x: np.ndarray, lengths, rung: int, slot_ids
-                      ) -> Tuple[object, int]:
-        """Dispatch a prefill — fill ``slot_ids``' pages on cache rung
-        ``rung`` from prompt bucket ``x`` ((n, S) ids, right-padded;
-        ``lengths`` the real prompt lengths) — WITHOUT syncing the
-        last-real-position logits back: returns ((b, vocab) DEVICE
-        logits, snapshot generation).  Rows are padded up to a prefill
-        batch rung; pad rows run against the scratch slot.  The
-        scheduler dispatches the tick's prefill before fetching its
-        decode chunks, so prompt compute overlaps decode sampling."""
-        n, s = x.shape
+    def _table(self, page_lists, b: int) -> np.ndarray:
+        """Pad per-row page lists into the (b, P) int32 dispatch table:
+        P is the page rung over the widest row, unused slots point at
+        scratch (positions there sit past every row's fill, so masking
+        never lets them matter)."""
+        width = self._page_rung(max([len(p) for p in page_lists] + [1]))
+        tbl = np.full((b, width), self.scratch, np.int32)
+        for i, pages in enumerate(page_lists):
+            tbl[i, :len(pages)] = pages
+        return tbl
+
+    def _sampling_args(self, b, temps, top_ks, seeds):
+        tp = np.zeros((b,), np.float32)
+        tp[:len(temps)] = temps
+        tk = np.zeros((b,), np.int32)
+        tk[:len(top_ks)] = top_ks
+        sd = np.zeros((b,), np.uint32)
+        sd[:len(seeds)] = seeds
+        return tp, tk, sd
+
+    def prefill_async(self, x: np.ndarray, t0s, n_new, page_lists,
+                      temps, top_ks, seeds):
+        """Dispatch one prefill CHUNK over co-batched rows — row ``i``
+        holds prompt tokens ``x[i, :n_new[i]]`` at global positions
+        starting ``t0s[i]``, its cache (covering ``[0 .. t0+n_new)``)
+        listed in ``page_lists[i]`` — WITHOUT syncing results back:
+        returns ((b,) DEVICE next tokens, (b,) DEVICE logprobs,
+        (b, vocab) DEVICE logits, snapshot generation).  Rows pad to a
+        prefill batch rung against the scratch page.  The sampled
+        token is the row's next token only when this chunk completes
+        its prompt — intermediate chunks' samples are discarded."""
+        n, c = x.shape
+        if c != self.prefill_chunk:
+            raise ValueError(f"chunk width {c} != prefill_chunk "
+                             f"{self.prefill_chunk}")
         b = self._batch_rung(self.prefill_rungs, n)
-        xb = np.zeros((b, s), self.runner.dtype)
+        xb = np.zeros((b, c), self.runner.dtype)
         xb[:n] = x
-        ln = np.ones((b,), np.int32)
-        ln[:n] = lengths
-        sl = np.full((b,), self.scratch, np.int32)
-        sl[:n] = slot_ids
+        t0 = np.zeros((b,), np.int32)
+        t0[:n] = t0s
+        nn = np.ones((b,), np.int32)
+        nn[:n] = n_new
+        tbl = self._table(list(page_lists) + [[]] * (b - n), b)
+        tp, tk, sd = self._sampling_args(b, temps, top_ks, seeds)
         self.runner._maybe_stall()
         params, gen = self.runner._active
-        logits, pk, pv = self._run_jit(
-            ("prefill", b, s, rung), self._prefill,
-            (params, self.pk[rung], self.pv[rung], xb, ln, sl))
-        self.pk[rung], self.pv[rung] = pk, pv
-        return logits, gen
+        tok, logp, logits, self.pk, self.pv = self._run_jit(
+            ("prefill", b, tbl.shape[1]), self._prefill,
+            (params, self.pk, self.pv, tbl, xb, t0, nn, tp, tk, sd))
+        return tok, logp, logits, gen
 
-    def prefill(self, x: np.ndarray, lengths, rung: int, slot_ids
-                ) -> Tuple[np.ndarray, int]:
-        """Synchronous :meth:`prefill_async`: ((n, vocab) host logits,
-        generation)."""
-        logits, gen = self.prefill_async(x, lengths, rung, slot_ids)
-        return np.asarray(logits)[:len(slot_ids)], gen
+    def prefill(self, x: np.ndarray, t0s, n_new, page_lists,
+                temps, top_ks, seeds):
+        """Synchronous :meth:`prefill_async` (host arrays, sliced to
+        the real rows)."""
+        tok, logp, logits, gen = self.prefill_async(
+            x, t0s, n_new, page_lists, temps, top_ks, seeds)
+        n = len(page_lists)
+        return (np.asarray(tok)[:n], np.asarray(logp)[:n],
+                np.asarray(logits)[:n], gen)
 
-    def decode_async(self, rung: int, slot_ids, tokens, ts
-                     ) -> Tuple[object, int]:
-        """Dispatch one decode chunk over co-batched requests sharing
-        cache rung ``rung`` — feed each row's ``tokens[i]`` at its own
-        depth ``ts[i]``, append k/v — WITHOUT syncing the logits back:
-        returns ((b, vocab) DEVICE logits — ``np.asarray`` then slice
-        ``[:n]`` to fetch — and the snapshot generation).  The
-        scheduler dispatches every cache-rung chunk of a tick before
-        fetching any, so chunk N's compute overlaps chunk N-1's
-        host-side sampling and reply shipping."""
-        n = len(slot_ids)
+    def decode_async(self, page_lists, tokens, ts, temps, top_ks,
+                     seeds):
+        """Dispatch one decode step over co-batched requests — feed
+        each row's ``tokens[i]`` at its own depth ``ts[i]``, append
+        k/v into its paged cache — WITHOUT syncing results back:
+        returns ((b,) DEVICE next tokens, (b,) DEVICE logprobs,
+        (b, vocab) DEVICE logits, snapshot generation).  The scheduler
+        dispatches every chunk of a tick before fetching any, so chunk
+        N's compute overlaps chunk N-1's host-side emit."""
+        n = len(page_lists)
         b = self._batch_rung(self.decode_rungs, n)
-        sl = np.full((b,), self.scratch, np.int32)
-        sl[:n] = slot_ids
-        tk = np.zeros((b,), self.runner.dtype)
-        tk[:n] = tokens
+        tbl = self._table(list(page_lists) + [[]] * (b - n), b)
+        tk_in = np.zeros((b,), self.runner.dtype)
+        tk_in[:n] = tokens
         tt = np.zeros((b,), np.int32)
         tt[:n] = ts
+        tp, tk, sd = self._sampling_args(b, temps, top_ks, seeds)
         self.runner._maybe_stall()
         params, gen = self.runner._active
-        logits, pk, pv = self._run_jit(
-            ("decode", b, rung), self._decode,
-            (params, self.pk[rung], self.pv[rung], sl, tk, tt))
-        self.pk[rung], self.pv[rung] = pk, pv
-        return logits, gen
+        tok, logp, logits, self.pk, self.pv = self._run_jit(
+            ("decode", b, tbl.shape[1]), self._decode,
+            (params, self.pk, self.pv, tbl, tk_in, tt, tp, tk, sd))
+        return tok, logp, logits, gen
 
-    def decode(self, rung: int, slot_ids, tokens, ts
-               ) -> Tuple[np.ndarray, int]:
-        """Synchronous :meth:`decode_async`: ((n, vocab) host logits,
-        generation)."""
-        logits, gen = self.decode_async(rung, slot_ids, tokens, ts)
-        return np.asarray(logits)[:len(slot_ids)], gen
+    def decode(self, page_lists, tokens, ts, temps, top_ks, seeds):
+        """Synchronous :meth:`decode_async` (host arrays, sliced to
+        the real rows)."""
+        tok, logp, logits, gen = self.decode_async(
+            page_lists, tokens, ts, temps, top_ks, seeds)
+        n = len(page_lists)
+        return (np.asarray(tok)[:n], np.asarray(logp)[:n],
+                np.asarray(logits)[:n], gen)
 
-    def migrate(self, src_rung: int, src_slot: int, dst_rung: int,
-                dst_slot: int) -> None:
-        """Prefix-copy one slot's page up a rung (the request outgrew
-        ``src_rung``).  Slot bookkeeping is the caller's."""
-        pk, pv = self._run_jit(
-            ("migrate", src_rung, dst_rung), self._migrate,
-            (self.pk[src_rung], self.pv[src_rung],
-             self.pk[dst_rung], self.pv[dst_rung],
-             np.int32(src_slot), np.int32(dst_slot)))
-        self.pk[dst_rung], self.pv[dst_rung] = pk, pv
+    def copy_page(self, src: int, dst: int) -> None:
+        """Whole-page copy (the COW move): duplicate page ``src`` into
+        ``dst`` across every layer's k and v pools.  Reference
+        bookkeeping is the caller's."""
+        self.pk, self.pv = self._run_jit(
+            ("copy",), self._copy,
+            (self.pk, self.pv, np.int32(src), np.int32(dst)))
 
     # -- contract surface ------------------------------------------------------
 
     def executables(self) -> int:
         """The warmed generation executable count — the zero-recompile
         gate's expected jit-cache contribution."""
-        return (len(self.prefill_rungs) * len(self.prompt_rungs)
-                + len(self.decode_rungs) * len(self.cache_rungs)
-                + max(0, len(self.cache_rungs) - 1))
+        return ((len(self.prefill_rungs) + len(self.decode_rungs))
+                * len(self.page_rungs) + 1)
 
     def warmup(self) -> int:
         """Compile the full generation executable family up front (all
-        batches against the scratch slot — no real page is touched);
+        rows against the scratch page — no real page is touched);
         returns the owning runner's total ``compiles`` afterwards."""
+        c = self.prefill_chunk
         for b in self.prefill_rungs:
-            for s in self.prompt_rungs:
-                self.prefill(np.zeros((b, s), self.runner.dtype),
-                             np.ones(b, np.int32), self._rung_for(s),
-                             [self.scratch] * b)
+            for width in self.page_rungs:
+                self.prefill(np.zeros((b, c), self.runner.dtype),
+                             np.zeros(b, np.int32),
+                             np.ones(b, np.int32),
+                             [[self.scratch] * width] * b,
+                             np.zeros(b, np.float32),
+                             np.zeros(b, np.int32),
+                             np.zeros(b, np.uint32))
         for b in self.decode_rungs:
-            for c in self.cache_rungs:
-                self.decode(c, [self.scratch] * b, np.zeros(b, np.int64),
-                            np.zeros(b, np.int64))
-        for lo, hi in zip(self.cache_rungs, self.cache_rungs[1:]):
-            self.migrate(lo, self.scratch, hi, self.scratch)
+            for width in self.page_rungs:
+                self.decode([[self.scratch] * width] * b,
+                            np.zeros(b, np.int64),
+                            np.zeros(b, np.int32),
+                            np.zeros(b, np.float32),
+                            np.zeros(b, np.int32),
+                            np.zeros(b, np.uint32))
+        self.copy_page(self.scratch, self.scratch)
         return self.runner.compiles
 
     def jit_cache_size(self) -> Optional[int]:
@@ -1031,18 +1305,31 @@ class GenerationRunner:
         try:
             return int(self._prefill._cache_size()
                        + self._decode._cache_size()
-                       + self._migrate._cache_size())
+                       + self._copy._cache_size())
         except Exception:           # pragma: no cover - jax-version dep
             return None
 
     def stats(self) -> Dict:
-        return {"cache_rungs": list(self.cache_rungs),
-                "prompt_rungs": list(self.prompt_rungs),
+        return {"page_size": self.page_size,
+                "num_pages": self.num_pages,
+                "page_rungs": list(self.page_rungs),
+                "prefill_chunk": self.prefill_chunk,
+                "max_ctx": self.max_ctx,
+                "slots": self.slots,
                 "prefill_rungs": list(self.prefill_rungs),
                 "decode_rungs": list(self.decode_rungs),
-                "slots_per_rung": self.slots,
-                "slots_total": self.slots * len(self.cache_rungs),
-                "slots_active": self.slots_active(),
+                "pages_active": self.pages_active(),
+                "pages_free": len(self._free_pages),
+                "pages_shared": int((self.page_ref > 1).sum()),
+                "pages_leaked": self.pages_leaked(),
+                "prefix_enabled": self.prefix is not None,
+                "prefix_pages": (len(self.prefix)
+                                 if self.prefix is not None else 0),
+                "prefix_hits": int(self._pm["hits"].value),
+                "prefix_misses": int(self._pm["misses"].value),
+                "prefix_evictions": int(self._pm["evictions"].value),
+                "prefix_tokens_avoided":
+                    int(self._pm["tokens_avoided"].value),
                 "occupancy": self.occupancy(),
                 "executables": self.executables(),
                 "aot_loaded": len(self._aot),

@@ -534,26 +534,42 @@ class WebStatus:
                             f"{brows}</table>")
                         gen = serving.get("generate")
                         if gen:
-                            # the generation row (ISSUE 16): continuous-
-                            # batching health — decode cadence, KV-slot
-                            # occupancy, prefill/decode split, migrations
+                            # the generation rows (ISSUE 16/19):
+                            # continuous-batching health — decode
+                            # cadence, paged-pool occupancy, prefill/
+                            # decode split — plus the prefix/paging row
+                            # (shared pages, COW traffic, avoided work)
                             serving_html += (
                                 f"<p>generation: active {gen['active']}, "
-                                f"pending {gen['pending']}, KV slots "
-                                f"{gen['slots_active']}/"
-                                f"{gen['slots_total']}, inter-token p50 "
+                                f"pending {gen['pending']}, KV pages "
+                                f"{gen['pages_active']}/"
+                                f"{gen['num_pages']} "
+                                f"(leaked {gen['pages_leaked']}), "
+                                f"inter-token p50 "
                                 f"{gen['inter_token_p50_ms']} ms / p99 "
                                 f"{gen['inter_token_p99_ms']} ms; "
                                 f"tokens {gen['generated_tokens']} "
                                 f"(prefill {gen['prefill_batches']} "
-                                f"batches / {gen['prefill_tokens']} "
+                                f"chunks / {gen['prefill_tokens']} "
                                 f"tokens, decode {gen['decode_batches']} "
                                 f"ticks / {gen['decode_tokens']} tokens), "
-                                f"migrations {gen['migrations']}, "
                                 f"finished {gen['gen_finished']}, "
                                 f"truncated {gen['gen_truncated']}, "
-                                f"timed out {gen['gen_timed_out']}, "
-                                f"cache rungs {gen['cache_rungs']}</p>")
+                                f"timed out {gen['gen_timed_out']}</p>"
+                                f"<p>paging: page size {gen['page_size']}"
+                                f", prefill chunk {gen['prefill_chunk']}"
+                                f", prefix cache "
+                                f"{'on' if gen['prefix_enabled'] else 'off'}"
+                                f" ({gen['prefix_pages']} pages indexed, "
+                                f"{gen['pages_shared']} shared, "
+                                f"{gen['prefix_hits']} hits / "
+                                f"{gen['prefix_misses']} misses, "
+                                f"{gen['prefix_tokens_avoided']} prompt "
+                                f"tokens avoided), "
+                                f"COW copies {gen['cow_copies']}, "
+                                f"on-device sampling "
+                                f"{'on' if gen['on_device_sampling'] else 'off'}"
+                                f" ({gen['fetch_bytes']} B fetched)</p>")
                     bal = snap.get("balancer")
                     if bal:
                         # the fleet panel (ISSUE 12): one row per
