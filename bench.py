@@ -3951,6 +3951,331 @@ def telemetry_main() -> None:
             f"{TELEMETRY_GATE_PCT}% gate on the training hot loop")
 
 
+#: --obs protocol knobs (ISSUE 20): the fleet observability plane.
+#: Three gates, one JSON line.  (1) The PR 5 overhead bar re-run on the
+#: SERVING hot loop: interleaved set_enabled(on/off) windows over a
+#: pipelined closed-loop infer stream against a real InferenceServer —
+#: relative and same-process, so it holds on this swinging-cgroup host
+#: and transfers to a TPU host unchanged.  (2) A seeded chaos run over
+#: scripted replicas (zero warmup: the gate is about the JOURNAL, not
+#: the model): a blackholed replica under flood forces a failover, a
+#: forced-high autoscaler band spawns, and a parity-mismatching swap
+#: rolls back — the event journal must contain that causal chain with
+#: first-occurrence order failover < autoscale_up < rollback and
+#: strictly monotone seqs.  (3) Stitching across REAL OS processes: two
+#: subprocess charlm generation replicas announce to an in-process
+#: balancer; one generation request must land in the fleet trace store
+#: as a single trace_id crossing >=3 fleet origins on >=2 distinct OS
+#: pids (client + balancer in this interpreter, frontend/scheduler
+#: spans shipped back on heartbeats and reply summaries from a child).
+OBS_SEED = 2008
+OBS_GATE_PCT = 2.0          # enabled may cost at most this much
+OBS_WINDOW_REQS = 300       # closed-loop requests per on/off window
+OBS_INFLIGHT = 16           # client pipeline depth in the windows
+OBS_MAX_ROUNDS = 6          # bounded interleaved best-of pairs
+OBS_CHAOS_STAGE_S = 20.0    # per-stage flood budget in the chaos run
+OBS_GEN_REPLICAS = 2        # subprocess generation replicas
+OBS_GEN_BOOT_S = 300.0      # child compile+announce budget (1 core)
+OBS_STITCH_S = 60.0         # generation stitching budget
+
+#: The gate-3 child: a real OS process running one tiny charlm
+#: generation replica that announces to the parent's balancer.  Spans
+#: ride its heartbeats; params are seed-pinned so both children answer
+#: bit-identically (routing stays free).
+_OBS_CHILD = """
+import sys
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+root.charlm.loader.update({"n_train": 64, "n_valid": 16, "n_test": 0,
+                           "seq_len": 32, "minibatch_size": 16})
+root.charlm.model.update({"vocab": 32, "embed": 32, "heads": 2,
+                          "ffn": 64})
+root.common.serving.seq.rungs = [8, 32]
+root.common.serving.generate.update({"enabled": True, "page_size": 8,
+                                     "slots": 4})
+prng.reset(1013)
+from znicz_tpu.samples.charlm import CharLMWorkflow
+from znicz_tpu.serving import InferenceServer
+wf = CharLMWorkflow()
+wf.initialize(device=None)
+srv = InferenceServer(wf, max_batch=4, max_delay_ms=1.0,
+                      announce=sys.argv[1],
+                      replica_id=sys.argv[2]).start()
+sys.stdin.read()        # parent closes stdin -> clean exit
+srv.stop()
+"""
+
+
+def obs_main() -> None:
+    """``--obs``: the fleet observability gates (ISSUE 20), one JSON
+    line; gates AFTER the line so a trip never destroys the record."""
+    import subprocess
+    import time as _time
+
+    from znicz_tpu import telemetry
+    from znicz_tpu.parallel.chaos import FleetScaler, ScriptedReplica
+    from znicz_tpu.serving import (InferenceClient, InferenceServer,
+                                   ReplicaBalancer)
+
+    sys.setswitchinterval(1e-3)
+    telemetry.set_enabled(True)
+    rng = np.random.default_rng(OBS_SEED)
+
+    # ---- gate 1: serving hot-loop overhead, interleaved on/off ----------
+    srv = InferenceServer(_build_fleet_workflow(),
+                          max_batch=FLEET_MAX_BATCH, max_delay_ms=1.0,
+                          queue_bound=64).start()
+    cli = InferenceClient(srv.endpoint, timeout=30.0,
+                          breaker_failures=0)
+    x1 = rng.normal(0, 1, (1, 28 * 28)).astype(np.float32)
+
+    def window(enabled: bool) -> float:
+        """Per-request wall time of one pipelined closed-loop window
+        (submission capped at OBS_INFLIGHT in flight)."""
+        telemetry.set_enabled(enabled)
+        sent = done = 0
+        t0 = _time.perf_counter()
+        while done < OBS_WINDOW_REQS:
+            while sent < OBS_WINDOW_REQS and \
+                    cli.in_flight < OBS_INFLIGHT:
+                cli.submit(x1)
+                sent += 1
+            done += sum(1 for _ in cli.collect(0.001))
+        return (_time.perf_counter() - t0) / OBS_WINDOW_REQS
+
+    window(True)                    # compile + cache warm, both variants
+    window(False)
+    best_on = best_off = float("inf")
+    rounds = []
+    overhead_pct = float("inf")
+    for _ in range(OBS_MAX_ROUNDS):
+        best_off = min(best_off, window(False))
+        best_on = min(best_on, window(True))
+        overhead_pct = 100.0 * (best_on / best_off - 1.0)
+        rounds.append({"off_req_ms": round(best_off * 1e3, 4),
+                       "on_req_ms": round(best_on * 1e3, 4),
+                       "overhead_pct": round(overhead_pct, 3)})
+        if overhead_pct <= OBS_GATE_PCT:
+            break                   # gate met; no need to re-roll
+    telemetry.set_enabled(True)
+    cli.close()
+    srv.stop()
+
+    # ---- gate 2: seeded chaos -> the journal's causal chain -------------
+    cur0 = telemetry.journal().last_seq
+    bal = ReplicaBalancer(replica_ttl_s=1.0, heartbeat_s=0.25,
+                          failover_timeout_s=0.5, failover_tries=4,
+                          hedge=False, canary_requests=6,
+                          parity_every=2, canary_timeout_s=20.0,
+                          min_replicas=2).start()
+    reps = [ScriptedReplica(bal.endpoint, f"r{i}",
+                            snapshots={"diff": 3.0}).start()
+            for i in range(2)]
+    t0 = _time.time()
+    while bal.ready_count() < 2:
+        if _time.time() - t0 > 20:
+            raise SystemExit("obs chaos fleet never became ready")
+        _time.sleep(0.02)
+    cli2 = InferenceClient(bal.endpoint, timeout=10.0,
+                           breaker_failures=0, resend_after_s=30.0)
+    x4 = np.arange(4, dtype=np.float32).reshape(1, 4) + 1.0
+
+    def flood(pred, budget_s=OBS_CHAOS_STAGE_S):
+        """Closed-loop flood until ``pred`` holds (refusals during the
+        swap wave are expected traffic, not errors)."""
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < budget_s:
+            try:
+                cli2.result(cli2.submit(x4), timeout=8)
+            except Exception:
+                pass
+            if pred():
+                return True
+        return pred()
+
+    # stage A (preemption under flood): a blackholed replica swallows
+    # dispatches; the failover timeout re-dispatches them
+    hole = ScriptedReplica(bal.endpoint, "hole", blackhole=True).start()
+    t0 = _time.time()
+    while "hole" not in {m["replica_id"]
+                         for m in bal.stats()["replicas"]}:
+        if _time.time() - t0 > 10:
+            raise SystemExit("blackhole replica never joined")
+        _time.sleep(0.02)
+    failover_ok = flood(lambda: bal.failovers >= 1)
+    # stage B: a forced-high band spawns through the FleetScaler
+    scaler = FleetScaler(
+        lambda i: ScriptedReplica(bal.endpoint, f"s{i}",
+                                  snapshots={"diff": 3.0}))
+    bal.enable_autoscale(
+        scaler.spawn, scaler.retire, autoscale_max=4,
+        autoscale_high_load=-1.0, autoscale_low_load=-2.0,
+        autoscale_up_after=2, autoscale_down_after=2,
+        autoscale_eval_s=0.05, autoscale_cooldown_s=0.05,
+        autoscale_drain_timeout_s=5.0)
+    scale_ok = flood(lambda: bal.scale_ups >= 1)
+    # neutralize the band (neither high nor low can fire) and clear the
+    # blackhole so the swap wave's canary probes cannot be swallowed
+    bal.enable_autoscale(
+        scaler.spawn, scaler.retire, autoscale_max=4,
+        autoscale_high_load=1e9, autoscale_low_load=-1.0)
+    hole.kill()
+    t0 = _time.time()
+    while "hole" in {m["replica_id"]
+                     for m in bal.stats()["replicas"]}:
+        if _time.time() - t0 > 15:
+            break
+        _time.sleep(0.05)
+    # stage C: a parity-mismatching swap must auto-roll-back
+    cli2._send({"cmd": "swap", "path": "diff"})
+    rollback_ok = flood(lambda: bal.rollbacks >= 1, budget_s=40.0)
+
+    events = telemetry.journal().since(cur0)
+    seqs = [e["seq"] for e in events]
+    monotone = all(b > a for a, b in zip(seqs, seqs[1:]))
+    first: dict = {}
+    for e in events:
+        first.setdefault(e["kind"], e["seq"])
+    chain = [{"kind": k, "seq": first.get(k)}
+             for k in ("failover", "autoscale_up", "rollback")]
+    chain_ok = (None not in [c["seq"] for c in chain]
+                and chain[0]["seq"] < chain[1]["seq"] < chain[2]["seq"])
+    scale_evt = next((e for e in events
+                      if e["kind"] == "autoscale_up"), {})
+    cli2.close()
+    bal.stop()
+    scaler.stop_all()
+    for r in reps:
+        r.kill()
+
+    # ---- gate 3: one generation request stitched across OS processes ----
+    bal3 = ReplicaBalancer(replica_ttl_s=2.5, heartbeat_s=0.25).start()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _OBS_CHILD, bal3.endpoint, f"g{i}"],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, env=env)
+        for i in range(OBS_GEN_REPLICAS)]
+    my_pid = str(os.getpid())
+
+    def stitched_gen_trace():
+        """A trace crossing >=3 fleet origins with at least one span
+        from a DIFFERENT OS pid (gate-2 leftovers can't qualify: their
+        spans all carry this interpreter's pid)."""
+        for tid, members in telemetry.fleet_trace().traces().items():
+            origins: list = []
+            for o, _ in members:
+                if o not in origins:
+                    origins.append(o)
+            pids = {o.rsplit("@", 1)[-1] for o in origins}
+            if len(origins) >= 3 and any(p != my_pid for p in pids):
+                if all(s.get("args", {}).get("trace_id") == tid
+                       for _, s in members):
+                    return tid, origins, pids, members
+        return None
+
+    stitched = None
+    gen_replies = 0
+    try:
+        t0 = _time.time()
+        while bal3.ready_count() < OBS_GEN_REPLICAS:
+            for p in procs:
+                if p.poll() is not None:
+                    raise SystemExit(
+                        f"obs generation child exited rc={p.returncode} "
+                        f"before announcing")
+            if _time.time() - t0 > OBS_GEN_BOOT_S:
+                raise SystemExit("obs generation fleet never became "
+                                 "ready")
+            _time.sleep(0.2)
+        boot_s = _time.time() - t0
+        cli3 = InferenceClient(bal3.endpoint, timeout=90.0,
+                               breaker_failures=0)
+        deadline = _time.time() + OBS_STITCH_S
+        while _time.time() < deadline and stitched is None:
+            prompt = rng.integers(1, 32, size=6).astype(np.uint8)
+            rep = cli3.generate(prompt, max_new_tokens=8, timeout=90)
+            assert len(rep["tokens"]) >= 1
+            gen_replies += 1
+            _time.sleep(0.05)
+            stitched = stitched_gen_trace()
+        cli3.close()
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except Exception:
+                p.kill()
+        bal3.stop()
+
+    tid, origins, pids, members = stitched or (None, [], set(), [])
+    names = sorted({s.get("name", "") for _, s in members})
+    print(json.dumps({
+        "metric": "obs_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "gate_pct": OBS_GATE_PCT,
+        "req_ms_disabled": round(best_off * 1e3, 4),
+        "req_ms_enabled": round(best_on * 1e3, 4),
+        "window_reqs": OBS_WINDOW_REQS,
+        "rounds": rounds,
+        "seed": OBS_SEED,
+        "chaos": {
+            "events": len(events),
+            "monotone_seqs": monotone,
+            "chain": chain,
+            "autoscale_load": scale_evt.get("load"),
+            "failovers": failover_ok,
+            "scale_ups": scale_ok,
+            "rollbacks": rollback_ok,
+        },
+        "stitched": {
+            "trace_id": tid,
+            "origins": origins,
+            "os_pids": sorted(pids),
+            "spans": len(members),
+            "names": names,
+            "gen_replies": gen_replies,
+            "fleet_boot_s": round(boot_s, 1),
+        },
+    }))
+    # gates AFTER the JSON line (the record survives a trip)
+    failures = []
+    if overhead_pct > OBS_GATE_PCT:
+        failures.append(
+            f"observability overhead {overhead_pct:.3f}% exceeds the "
+            f"{OBS_GATE_PCT}% gate on the serving hot loop")
+    if not (failover_ok and scale_ok and rollback_ok):
+        failures.append(
+            f"chaos stages incomplete: failover={failover_ok} "
+            f"autoscale={scale_ok} rollback={rollback_ok}")
+    if not monotone:
+        failures.append("journal seqs are not strictly monotone")
+    if not chain_ok:
+        failures.append(
+            f"journal lacks the failover -> autoscale_up -> rollback "
+            f"causal chain: {chain}")
+    if "load" not in scale_evt:
+        failures.append("the autoscale_up event does not carry the "
+                        "load numbers that drove it")
+    if stitched is None:
+        failures.append(
+            f"no generation trace stitched across >=3 fleet origins "
+            f"and >=2 OS pids within {OBS_STITCH_S:.0f}s "
+            f"({gen_replies} generations served)")
+    elif len(pids) < 2:
+        failures.append(f"stitched trace stayed inside one OS "
+                        f"process: {sorted(pids)}")
+    if failures:
+        raise SystemExit("obs gates failed: " + "; ".join(failures))
+
+
 def _gd_finals(decision) -> dict:
     from znicz_tpu.loader.base import TRAIN, VALID
 
@@ -4077,6 +4402,8 @@ if __name__ == "__main__":
         measure_samples()
     elif "--telemetry" in args:
         telemetry_main()
+    elif "--obs" in args:
+        obs_main()
     elif "--ingest" in args:
         ingest_main()
     elif "--wire" in args:

@@ -202,10 +202,15 @@ def test_bf16_state_dtype_parity_mnist(tmp_path):
     assert losses[-1] < losses[0] * 0.7
 
 
+@pytest.mark.slow
 def test_bf16_state_dtype_parity_cifar(tmp_path):
     """Same property on the CIFAR anchor (conv net, the BASELINE
     config[1] gate): bf16 velocities track the f32 trajectory and the
-    anchor's beats-chance bar still holds."""
+    anchor's beats-chance bar still holds.
+
+    Slow-marked (ISSUE 7 budget discipline): the property itself stays
+    tier-1 via the mnist twin above; this conv-anchor re-run cost ~70s
+    of a budget the suite had outgrown."""
     from znicz_tpu.core import prng
     from znicz_tpu.samples import cifar
 

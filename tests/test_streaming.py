@@ -272,10 +272,16 @@ def test_image_file_source_streams(tmp_path):
     assert np.isfinite(wf.decision.epoch_metrics[2]["loss"])
 
 
+@pytest.mark.slow
 def test_bench_stream_protocol_smoke(capsys):
     """bench --stream at tiny shapes: the whole protocol (resident
     reference, u8-tiled window, staged segments, link probe) runs and the
-    JSON line carries the self-explaining roofline fields."""
+    JSON line carries the self-explaining roofline fields.
+
+    Slow-marked (ISSUE 7 budget discipline, the r24 precedent): this is
+    a smoke of the ``bench.py --stream`` protocol, whose real gates run
+    as the bench itself — tier-1 keeps the streaming-loader unit tests
+    above, and at ~98s this was the single heaviest tier-1 entry."""
     import json
 
     import bench

@@ -379,6 +379,33 @@ def test_training_wire_and_serving_spans_in_one_run_and_metrics_cover():
                          rf'component="{name}"', re.M)
         assert pat.search(text), f"{name}/{series} missing from /metrics"
 
+    # ISSUE 20: the same pins must survive the FLEET-merged exposition.
+    # Merging a member snapshot may only APPEND member-labeled rows
+    # under the same families — every local series line survives
+    # verbatim and every pinned series still matches.
+    from znicz_tpu.telemetry.fleet import (FleetMetricsStore,
+                                           registry_snapshot,
+                                           render_fleet_prometheus)
+
+    store = FleetMetricsStore()
+    store.update("r9@1234", registry_snapshot(telemetry.registry()))
+    merged = render_fleet_prometheus(telemetry.registry(), store)
+    _validate_exposition(merged)
+    merged_lines = set(merged.splitlines())
+    for ln in text.splitlines():
+        assert ln in merged_lines, \
+            f"local series line lost in the fleet merge: {ln}"
+    for name, series in [("master", "jobs_done"),
+                         ("serving", "served"),
+                         ("batcher", "batches"),
+                         ("trainer", "train_steps")]:
+        hits = [ln for ln in merged.splitlines()
+                if ln.startswith(f"znicz_{series}")
+                and f'component="{name}"' in ln
+                and 'member="r9@1234"' in ln]
+        assert hits, \
+            f"{name}/{series} has no member row in the fleet merge"
+
 
 # -- concurrent-scrape de-flake guard (ISSUE 5 satellite) ----------------------
 

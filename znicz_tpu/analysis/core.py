@@ -206,13 +206,14 @@ def collect_modules(pkg_dir: pathlib.Path,
 def default_checkers(pkg_dir: pathlib.Path) -> List[Checker]:
     from .config_knob import ConfigKnobChecker
     from .counters import CounterRegistryChecker
+    from .event_journal import EventJournalChecker
     from .jit_purity import JitPurityChecker
     from .threads import ThreadSharedStateChecker
     from .transport_core import TransportCoreChecker
 
     return [ThreadSharedStateChecker(), JitPurityChecker(),
             ConfigKnobChecker(pkg_dir), CounterRegistryChecker(),
-            TransportCoreChecker()]
+            TransportCoreChecker(), EventJournalChecker()]
 
 
 def run(pkg_dir: pathlib.Path,

@@ -1,0 +1,120 @@
+"""event-journal: fleet state transitions must journal (ISSUE 20).
+
+The structured event journal (``znicz_tpu/telemetry/events.py``) is the
+fleet's causal timeline — "why did the fleet do X at t?" is only
+answerable if every state transition actually emits.  Counters made
+this mistake once already (PRs 1-4 grew silent ad-hoc accounting until
+the counter-registry rule fenced it); this rule fences the journal the
+same way: the named decision points below — the functions that mutate
+fleet membership, generation capacity, or quorum — must contain a
+``telemetry.emit(...)`` (or ``journal().emit(...)``) call.
+
+Two finding shapes:
+
+  - a listed function exists but has NO emit call — the transition
+    would be invisible to ``/events.json`` (fix: emit, with the numbers
+    that drove the decision);
+  - a listed function is GONE (renamed/refactored away) — the table
+    below is the contract and must move with the code, otherwise the
+    rule silently guards nothing.
+
+New transition sites join :data:`SITES` in the same PR that adds them;
+baseline-gated like every other rule (0 entries at introduction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List
+
+from .core import Checker, Finding, Module
+
+RULE = "event-journal"
+
+#: path (relative to znicz_tpu/) -> {qualified function: event kinds it
+#: must emit}.  The kinds are documentation for the reader; the check
+#: is "an emit call is present".
+SITES: Dict[str, Dict[str, str]] = {
+    "serving/balancer.py": {
+        "ReplicaBalancer._evict_member": "replica_lost",
+        "ReplicaBalancer._failover": "failover",
+        "ReplicaBalancer._maybe_heal": "heal",
+        "ReplicaBalancer._tick_autoscale": "autoscale_up/autoscale_down",
+        "ReplicaBalancer._handle_swap": "swap_begin",
+        "ReplicaBalancer._enter_phase": "swap_phase/swap_done",
+        "ReplicaBalancer._abort_to_rollback": "rollback",
+    },
+    "server.py": {
+        "Server._replan": "replan",
+        "Server._evict_dead_slaves": "preemption",
+        "Server._note_quorum": "quorum_degraded/quorum_restored",
+    },
+    "serving/model.py": {
+        "PrefixCache.evict_one": "prefix_evict",
+    },
+    "serving/batcher.py": {
+        "GenerationScheduler.submit": "page_shed (queue-bound shed)",
+        "GenerationScheduler._note_page_pressure": "page_shed",
+    },
+    "transport/retry.py": {
+        "CircuitBreaker._open": "breaker_open",
+    },
+}
+
+
+def _has_emit_call(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "emit":
+            return True
+        if isinstance(func, ast.Name) and func.id == "emit":
+            return True
+    return False
+
+
+def _qualified_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """{qualname: funcdef} for module- and class-level functions (one
+    nesting level — the depth every site in the table uses)."""
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+class EventJournalChecker(Checker):
+    name = RULE
+
+    def __init__(self, sites: Dict[str, Dict[str, str]] = SITES):
+        self.sites = sites
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        table = self.sites.get(module.rel)
+        if not table:
+            return []
+        findings: List[Finding] = []
+        fns = _qualified_functions(module.tree)
+        for qualname, kinds in sorted(table.items()):
+            fn = fns.get(qualname)
+            if fn is None:
+                findings.append(Finding(
+                    RULE, module.rel, 1,
+                    f"journaled transition site '{qualname}' not found — "
+                    f"the function moved or was renamed; update SITES in "
+                    f"znicz_tpu/analysis/event_journal.py so the rule "
+                    f"keeps guarding it"))
+                continue
+            if not _has_emit_call(fn):
+                findings.append(Finding(
+                    RULE, module.rel, fn.lineno,
+                    f"state transition '{qualname}' ({kinds}) does not "
+                    f"journal — emit a structured event via "
+                    f"telemetry.emit(...) with the numbers that drove "
+                    f"the decision"))
+        return findings

@@ -231,6 +231,12 @@ class Client:
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
         self._tracer = telemetry.tracer()
+        # fleet observability (ISSUE 20): this slave's identity + span
+        # exporter — completed spans and journal events piggyback on
+        # update messages to the master (or relay, which forwards)
+        telemetry.set_identity(f"slave-{self.slave_id}")
+        self._exporter = telemetry.exporter()
+        self._obs_ev_seq = 0            # journal piggyback cursor
         self.wire_dtype = "float32"     # resolved from config in run()
         self._delta_encoder = None
         #: the endpoint our relay advertised as ITS upstream (ISSUE 10):
@@ -291,6 +297,27 @@ class Client:
             for k, arr in f.params().items():
                 layer[k] = np.array(arr.map_read()) - before[f.name][k]
             out[f.name] = layer
+        return out
+
+    def _obs_payload(self) -> Dict:
+        """Fleet-observability piggyback for one update message (ISSUE
+        20): a bounded batch of this slave's exported spans plus fresh
+        journal events, keyed by its fleet origin.  Additive keys — a
+        pre-ISSUE-20 master ignores them; empty dict when there is
+        nothing to ship (the common case costs two deque peeks)."""
+        from znicz_tpu import telemetry
+
+        out: Dict = {}
+        spans = self._exporter.drain(telemetry.span_export_batch())
+        if spans:
+            out["spans"] = spans
+        ev = telemetry.journal().since(
+            self._obs_ev_seq, limit=telemetry.span_export_batch())
+        if ev:
+            self._obs_ev_seq = ev[-1]["seq"]
+            out["events"] = ev
+        if out:
+            out["origin"] = telemetry.identity()
         return out
 
     def _run_minibatch(self, job: dict, train: bool):
@@ -686,7 +713,8 @@ class Client:
                      # the master reads the delta's staleness off it
                      "step": rep.get("step"),
                      "deltas": self._delta_encoder.encode(deltas),
-                     "metrics": metrics})
+                     "metrics": metrics,
+                     **self._obs_payload()})
         finally:
             if prefetcher is not None:
                 prefetcher.stop()

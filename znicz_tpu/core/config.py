@@ -232,6 +232,12 @@ ENGINE_DEFAULTS = {
     "ingress_rate_burst": 0.0,    # bucket capacity; 0 = auto (1s rate)
     "job_deadline": True,         # stamp deadline_ms budgets on jobs;
     #                               expired jobs drop at slave/relay
+    # fleet observability (ISSUE 20): training-plane SLO — apply
+    # progress (accepted delta applies vs refused/stale/quarantined),
+    # advisory burn rates on /slo.json, never a readiness gate
+    "obs_slo_apply_progress": 0.99,
+    "obs_slo_fast_window_s": 60.0,
+    "obs_slo_slow_window_s": 600.0,
     "quarantine_norm_mult": 25.0,
     "master_snapshot_s": 10.0,
     "wire_dtype": "float32",      # "float32" | "bfloat16" | "int8"

@@ -291,6 +291,15 @@ class Relay:
         sc.gauge("relay_queue_depth", "jobs queued for children",
                  fn=weak_fn(self, lambda r: len(r._jobq)))
         self._tracer = telemetry.tracer()
+        # fleet observability (ISSUE 20): relay spans/events piggyback
+        # upstream on flush messages — the master ingests them under
+        # this origin, so a mid-tree hop shows up in stitched traces
+        telemetry.set_identity(self.relay_id)
+        self._exporter = telemetry.exporter()
+        self._obs_ev_seq = 0
+        #: children's piggybacked obs payloads awaiting the next flush
+        #: (bounded drop-oldest — observability never backs up a flush)
+        self._obs_fwd: List[dict] = []
 
     # -- introspection ---------------------------------------------------------
 
@@ -549,6 +558,7 @@ class Relay:
         return {"jobs": [e for e, _ in take], "params": params}
 
     def _child_update(self, req: dict, sid: str) -> dict:
+        self._buffer_child_obs(req, sid)
         deltas = req.get("deltas")
         contributors = req.get("contributors")
         if contributors is not None:
@@ -564,7 +574,17 @@ class Relay:
             if deltas:
                 entries[0]["delta"] = True
         if deltas:
+            tv0 = time.perf_counter() if self._tracer.enabled else None
             reason = self._validate_delta(deltas, max(1, n_delta))
+            if tv0 is not None:
+                # edge-validate span tagged with the contributor's
+                # trace_id (ISSUE 20 satellite: the leaf's trace thread
+                # survives the relay hop into the master-side timeline)
+                self._tracer.add(
+                    "relay", "edge_validate", tv0,
+                    time.perf_counter() - tv0,
+                    {"trace_id": entries[0].get("trace_id"),
+                     "refused": bool(reason), "n_delta": n_delta})
             if reason:
                 # refused at the edge: the partial sum stays clean, the
                 # child hears the master's quarantine wording, and the
@@ -662,6 +682,46 @@ class Relay:
                 "contributors": entries,
                 "deltas": self._enc.encode(summed) if summed else None}
 
+    def _buffer_child_obs(self, req: dict, sid: str) -> None:
+        """Hold a child's piggybacked spans/events (plus anything a
+        LOWER relay already forwarded) for the next upstream flush.
+        Each payload keeps the originating leaf's origin; the buffer is
+        bounded drop-oldest so a flush-starved window sheds telemetry,
+        never deltas."""
+        fwd = []
+        if req.get("spans") or req.get("events"):
+            fwd.append({"origin": str(req.get("origin") or sid),
+                        "spans": req.get("spans") or [],
+                        "events": req.get("events") or []})
+        fwd.extend(f for f in (req.get("fwd_obs") or [])
+                   if isinstance(f, dict))
+        if not fwd:
+            return
+        with self._lock:
+            self._obs_fwd.extend(fwd)
+            del self._obs_fwd[:-32]
+
+    def _obs_payload(self) -> dict:
+        """Fleet-observability piggyback for one upstream flush (ISSUE
+        20): a bounded batch of this relay's exported spans plus fresh
+        journal events, keyed by its fleet origin.  Additive keys — a
+        pre-ISSUE-20 upstream ignores them; empty dict when there is
+        nothing to ship."""
+        from znicz_tpu import telemetry
+
+        out: dict = {}
+        spans = self._exporter.drain(telemetry.span_export_batch())
+        if spans:
+            out["spans"] = spans
+        ev = telemetry.journal().since(
+            self._obs_ev_seq, limit=telemetry.span_export_batch())
+        if ev:
+            self._obs_ev_seq = ev[-1]["seq"]
+            out["events"] = ev
+        if out:
+            out["origin"] = telemetry.identity()
+        return out
+
     def _flush(self, final: bool = False) -> None:
         """Ship the buffered contributions upstream as ONE aggregated
         update: summed f32 deltas re-encoded per wire_dtype (error
@@ -679,8 +739,17 @@ class Relay:
             summed, self._sum = self._sum, {}
             self._sum_t0 = None
         t0 = time.perf_counter() if self._tracer.enabled else None
-        frames, _ = wire.encode_message(self._flush_message(entries,
-                                                           summed))
+        msg = self._flush_message(entries, summed)
+        # fleet observability (ISSUE 20): the flush carries this relay's
+        # own spans/events upstream as additive keys — NOT added inside
+        # _flush_message, whose output must stay deterministic for the
+        # byte-identity test (and the exporter drain is one-shot)
+        msg.update(self._obs_payload())
+        with self._lock:
+            fwd, self._obs_fwd = self._obs_fwd, []
+        if fwd:
+            msg["fwd_obs"] = fwd
+        frames, _ = wire.encode_message(msg)
         rep = self._upstream_rpc(frames=frames, one_shot=final)
         if rep is not None:
             # only a DELIVERED flush counts — rep None means not a
@@ -692,6 +761,9 @@ class Relay:
             self._tracer.add("relay", "flush", t0,
                              time.perf_counter() - t0,
                              {"contributors": len(entries),
+                              "trace_ids": [e.get("trace_id")
+                                            for e in entries
+                                            if e.get("trace_id")],
                               "delivered": rep is not None,
                               "bind": self.bind})
         if rep is not None and rep.get("complete"):

@@ -120,6 +120,24 @@ class Server:
         self._m = {name: _sc.counter(name, help)
                    for name, help in self.COUNTERS.items()}
         self._tracer = telemetry.tracer()
+        # -- fleet observability (ISSUE 20): the master is the training
+        # plane's coordinator — slave/relay updates piggyback spans and
+        # journal events that land in the fleet stores behind
+        # /trace.json?fleet=1 and /events.json
+        telemetry.set_identity("master")
+        self._quorum_degraded = False   # quorum journal episode latch
+        self._t_obs_drain = 0.0         # self-ingest rate limiter (s)
+        #: training-plane SLO (advisory burn rates on /slo.json; never
+        #: a readiness gate): apply progress — accepted delta applies
+        #: vs refused/stale/quarantined updates
+        self.slo = telemetry.register_slo(telemetry.SloTracker(
+            "training",
+            window_fast_s=float(root.common.engine.get(
+                "obs_slo_fast_window_s", 60.0)),
+            window_slow_s=float(root.common.engine.get(
+                "obs_slo_slow_window_s", 600.0))))
+        self.slo.add_objective("apply_progress", target=float(
+            root.common.engine.get("obs_slo_apply_progress", 0.99)))
         import uuid
 
         #: per-Server tag prefixing job trace_ids, so two masters'
@@ -403,6 +421,11 @@ class Server:
                 # a member lost while training continues: a preemption
                 # the elastic mode rode out (ISSUE 11)
                 self._m["preemptions_ridden"].inc()
+                from znicz_tpu import telemetry
+
+                telemetry.emit("preemption", "training", slave=sid,
+                               ttl_s=self.slave_ttl,
+                               members=self.member_count())
             if sid in self.relays:
                 # a relay eviction changes the TREE, not just the
                 # membership: re-plan so rehome targets and the
@@ -512,6 +535,7 @@ class Server:
         import logging
 
         self._m["stale_refused"].inc()
+        self.slo.record("apply_progress", False)
         job["_stale_refusals"] = job.get("_stale_refusals", 0) + 1
         requeue = (bool(job.get("last_minibatch"))
                    or job["_stale_refusals"] < self.MAX_BAD_REPLIES)
@@ -559,6 +583,23 @@ class Server:
         the /readyz-style membership signal (web_status.readiness)."""
         return not self.quorum_met() and not bool(self.decision.complete)
 
+    def _note_quorum(self) -> None:
+        """Journal the quorum-gate TRANSITIONS (ISSUE 20): degraded
+        once when membership falls below ``min_slaves`` mid-run,
+        restored once when it recovers — an episode latch, not a
+        per-tick emit."""
+        if self.min_slaves <= 0:
+            return
+        deg = self.degraded()
+        if deg == self._quorum_degraded:
+            return
+        from znicz_tpu import telemetry
+
+        telemetry.emit("quorum_degraded" if deg else "quorum_restored",
+                       "training", members=self.member_count(),
+                       min_slaves=self.min_slaves)
+        self._quorum_degraded = deg
+
     def _replan(self, why: str) -> None:
         """``plan_tree`` promoted to a RUNTIME re-planner (ISSUE 11):
         whenever live-relay membership changes (a relay joins, or TTL
@@ -578,6 +619,11 @@ class Server:
         self._tree_plan = {"relays": live, "reason": why,
                            "members": self.member_count()}
         self._m["replans"].inc()
+        from znicz_tpu import telemetry
+
+        telemetry.emit("replan", "training", why=why,
+                       relays=len(live),
+                       members=self._tree_plan["members"])
         logging.getLogger("znicz").info(
             "tree re-planned (%s): %d live relays, %d members", why,
             len(live), self._tree_plan["members"])
@@ -743,6 +789,7 @@ class Server:
         import logging
 
         self._m[counter].inc()
+        self.slo.record("apply_progress", False)
         job["_bad_replies"] = job.get("_bad_replies", 0) + 1
         requeue = (bool(job.get("last_minibatch"))
                    or job["_bad_replies"] < self.MAX_BAD_REPLIES)
@@ -943,6 +990,16 @@ class Server:
                 loop.stop()
                 return
             self._evict_dead_slaves()
+            self._note_quorum()
+            t = time.time()
+            if t - self._t_obs_drain > 0.25:
+                # the master's own spans/events join the fleet stores
+                # it coordinates (ISSUE 20; rate-limited)
+                self._t_obs_drain = t
+                from znicz_tpu import telemetry
+
+                telemetry.drain_own_spans()
+                telemetry.drain_own_events()
             self._maybe_save_resume()
 
         try:
@@ -1162,6 +1219,31 @@ class Server:
                 return dict(entries[0], params=params)
             return {"jobs": entries, "params": params}
         if cmd == "update":
+            # fleet observability piggyback (ISSUE 20): slaves/relays
+            # ride completed spans and journal events on their updates
+            # — additive keys, ignored by a pre-ISSUE-20 master
+            if (req.get("spans") or req.get("events")
+                    or req.get("fwd_obs")):
+                from znicz_tpu import telemetry
+
+                origin = str(req.get("origin") or sid)
+                if req.get("spans"):
+                    telemetry.fleet_trace().ingest(origin, req["spans"])
+                if req.get("events"):
+                    telemetry.fleet_events().ingest(origin,
+                                                    req["events"])
+                # obs payloads a relay tier forwarded on behalf of its
+                # leaves — each keeps the LEAF's origin, so a slave two
+                # hops down still renders as its own fleet participant
+                for fwd in req.get("fwd_obs") or []:
+                    if not isinstance(fwd, dict):
+                        continue
+                    fo = str(fwd.get("origin") or sid)
+                    if fwd.get("spans"):
+                        telemetry.fleet_trace().ingest(fo, fwd["spans"])
+                    if fwd.get("events"):
+                        telemetry.fleet_events().ingest(fo,
+                                                        fwd["events"])
             if "contributors" in req:
                 return self._handle_aggregated(req, sid)
             jid = req.get("job_id")
@@ -1234,6 +1316,7 @@ class Server:
                     # _feed_decision's .get calls
                     self._feed_decision(job, req.get("metrics") or {})
             self._m["jobs_done"].inc()
+            self.slo.record("apply_progress", True)
             self.jobs_by_slave[sid] = self.jobs_by_slave.get(sid, 0) + 1
             return {"ok": True, "complete": bool(self.decision.complete)}
         return {"error": f"unknown cmd {cmd!r}"}
@@ -1270,6 +1353,18 @@ class Server:
             raise ValueError("contributors manifest is not a list of "
                              "dicts")
         now = time.time()
+        if self._tracer.enabled:
+            # ISSUE 20 satellite: each contributor's trace_id reaches
+            # the MASTER-side timeline — a leaf's trace stitches
+            # through the relay hop instead of dead-ending there
+            t0 = time.perf_counter()
+            for c in contributors:
+                if c.get("trace_id"):
+                    self._tracer.add(
+                        "master", "aggregate_contrib", t0, 0.0,
+                        {"trace_id": c.get("trace_id"),
+                         "job_id": c.get("job_id"),
+                         "leaf": str(c.get("id", sid)), "relay": sid})
         n_delta = sum(1 for c in contributors if c.get("delta"))
         fresh: List[tuple] = []         # (contrib, job, staleness)
         malformed: List[tuple] = []     # (contrib, job, why)
@@ -1403,6 +1498,7 @@ class Server:
                     self._feed_decision(job, c.get("metrics") or {})
             cid = str(c.get("id", sid))
             self._m["jobs_done"].inc()
+            self.slo.record("apply_progress", True)
             self.jobs_by_slave[cid] = self.jobs_by_slave.get(cid, 0) + 1
             outcomes[c.get("job_id")] = "ok"
         self._m["aggregated_updates"].inc()

@@ -77,6 +77,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from znicz_tpu.core.config import root
+from znicz_tpu import telemetry
 from znicz_tpu.telemetry.metrics import registered_property
 
 from .frontend import DEFAULTS
@@ -89,7 +90,7 @@ class _Entry:
     __slots__ = ("rid", "client_rid", "envelope", "frames", "t_accept",
                  "deadline", "t_sent", "targets", "tries", "hedged",
                  "hedge_target", "held", "probe_rid", "kind",
-                 "primary_rid")
+                 "primary_rid", "trace_id")
 
     def __init__(self, rid: int, client_rid, envelope, frames,
                  deadline: float, kind: str = "infer"):
@@ -111,6 +112,7 @@ class _Entry:
         self.probe_rid: Optional[int] = None    # parity probe spawned
         self.primary_rid: Optional[int] = None  # set on probe entries
         self.kind = kind                    # "infer" | "probe" | "ctrl"
+        self.trace_id = None                # fleet stitching (ISSUE 20)
 
 
 def _cfg_balance() -> Dict:
@@ -185,6 +187,13 @@ class ReplicaBalancer:
                   fn=telemetry.weak_fn(self, lambda b: b.ready_count()))
         _sc.gauge("in_flight", "ledger entries awaiting a reply",
                   fn=telemetry.weak_fn(self, lambda b: b.in_flight))
+        # -- fleet observability (ISSUE 20): the balancer IS the
+        # serving coordinator — heartbeats/replies carry the fleet's
+        # spans, events and metric snapshots into the stores behind
+        # /trace.json?fleet=1, /events.json and the merged /metrics
+        self._tracer = telemetry.tracer()
+        telemetry.set_identity("balancer")
+        self._t_obs_drain = 0.0         # self-ingest rate limiter (s)
         # -- state below is serve-thread-written, stats()-read: every
         # mutation happens under _lock (REENTRANT: helpers lock their
         # own bodies — the thread lint's lexical contract — and are
@@ -439,6 +448,14 @@ class ReplicaBalancer:
                 # callbacks unlocked (a process spawn may block for
                 # seconds, and the ledger must keep ticking under it)
                 self._tick_autoscale()
+                # fleet self-ingest (ISSUE 20): the balancer's own
+                # spans/events join the stitched stores it coordinates
+                # (rate-limited — the stores lock internally)
+                t = time.perf_counter()
+                if t - self._t_obs_drain > 0.25:
+                    self._t_obs_drain = t
+                    telemetry.drain_own_spans()
+                    telemetry.drain_own_events()
 
             loop.add_tick(tick)
             self._ready.set()
@@ -500,12 +517,23 @@ class ReplicaBalancer:
         if cmd == "swap":
             self._handle_swap(envelope, skel)
             return
-        if cmd != "infer":
+        if cmd not in ("infer", "generate"):
             self._send_front(envelope, self.codec.encode(
                 {"ok": False, "req_id": rid, "lb": True,
                  "error": f"unknown cmd {cmd!r}"}))
             return
-        # -- accept one infer request into the ledger
+        if cmd == "generate" and skel.get("stream"):
+            # the exactly-once ledger is first-reply-wins: a streamed
+            # generation's partials would retire the entry on token 1
+            # and drop the rest as dups — refuse readably instead
+            self._send_front(envelope, self.codec.encode(
+                {"ok": False, "req_id": rid, "lb": True,
+                 "error": "balancer cannot relay streamed generation "
+                          "(first-reply-wins ledger needs ONE final "
+                          "reply) — set stream=False or connect to a "
+                          "replica directly"}))
+            return
+        # -- accept one infer/generate request into the ledger
         deadline_s = float(self.knobs["failover_tries"]) \
             * float(self.knobs["failover_timeout_s"])
         budget_ms = skel.get("deadline_ms")
@@ -522,6 +550,7 @@ class ReplicaBalancer:
             rewritten = wire.restamp_message(payload, req_id=lb_rid)
             entry = _Entry(lb_rid, rid, list(envelope), rewritten,
                            time.perf_counter() + deadline_s)
+            entry.trace_id = skel.get("trace_id")
             self._m["accepted"].inc()
             if not self._dispatch(entry):
                 if len(self._parked) >= int(self.knobs["park_bound"]):
@@ -567,6 +596,10 @@ class ReplicaBalancer:
                 "warm_misses": int(skel.get("warm_misses") or 0),
                 "boot_s": skel.get("boot_s"),
             }
+            if prev is None:
+                telemetry.emit("replica_joined", "serving",
+                               replica=replica_id, endpoint=endpoint,
+                               members=len(self._members))
             if prev is not None and prev["endpoint"] != endpoint:
                 # in-place endpoint change (wildcard-bind restart
                 # faster than the TTL): reap the old endpoint's socket
@@ -574,6 +607,16 @@ class ReplicaBalancer:
                 self._drop_unused_data_socks(
                     {m["endpoint"] for m in self._members.values()})
             self._maybe_heal(replica_id)
+        # fleet observability piggyback (ISSUE 20): spans, journal
+        # events and registry snapshots ride the beat — ingested OUTSIDE
+        # the membership lock (the fleet stores lock internally)
+        origin = str(skel.get("origin") or replica_id)
+        if skel.get("spans"):
+            telemetry.fleet_trace().ingest(origin, skel["spans"])
+        if skel.get("events"):
+            telemetry.fleet_events().ingest(origin, skel["events"])
+        if skel.get("metrics"):
+            telemetry.fleet_metrics().update(origin, skel["metrics"])
 
     def _maybe_heal(self, replica_id: str) -> None:
         """A replica whose boot snapshot disagrees with the promoted
@@ -598,6 +641,9 @@ class ReplicaBalancer:
             return
         self._healing[replica_id] = now
         self._m["heals"].inc()
+        telemetry.emit("heal", "serving", replica=replica_id,
+                       snapshot=m["snapshot_path"],
+                       fleet=self._fleet_path)
         self.log.info("healing %s: snapshot %r != fleet %r",
                       replica_id, m["snapshot_path"], self._fleet_path)
         self._send_ctrl(replica_id, {"cmd": "swap",
@@ -814,6 +860,20 @@ class ReplicaBalancer:
                                        lb=True)
             self._send_front(entry.envelope, out)
             self._m["replied" if ok else "refused"].inc()
+            if self._tracer.enabled and entry.trace_id:
+                # the balancer's hop in the stitched fleet timeline
+                self._tracer.add(
+                    "balancer", "request", entry.t_accept,
+                    time.perf_counter() - entry.t_accept,
+                    {"trace_id": entry.trace_id,
+                     "req_id": entry.client_rid,
+                     "replica": str(skel.get("replica_id") or ""),
+                     "tries": entry.tries})
+            if skel.get("spans") and skel.get("origin"):
+                # generation finals carry the replica's span summary —
+                # stitch it NOW (covers the pre-first-heartbeat window)
+                telemetry.fleet_trace().ingest(str(skel["origin"]),
+                                               skel["spans"])
             if entry.hedge_target is not None \
                     and str(skel.get("replica_id") or "") \
                     == entry.hedge_target:
@@ -916,6 +976,8 @@ class ReplicaBalancer:
                 {m["endpoint"] for m in self._members.values()})
             self.log.warning("replica %s evicted (%s); failing over "
                              "its in-flight requests", rid, why)
+            telemetry.emit("replica_lost", "serving", replica=rid,
+                           why=why, members=len(self._members))
             for entry in list(self._inflight.values()):
                 if entry.targets and entry.targets[-1] == rid:
                     self._failover(entry, exclude={rid})
@@ -938,6 +1000,9 @@ class ReplicaBalancer:
                     f"(replicas tried: {entry.targets}) — giving up")
                 return
             self._m["failovers"].inc()
+            telemetry.emit("failover", "serving",
+                           req_id=entry.client_rid, tries=entry.tries,
+                           targets=list(entry.targets))
             # exclude EVERY replica already tried (primary, hedge,
             # earlier failovers) — the try budget exists to spread
             # across the fleet; parking is the fallback when nobody
@@ -1129,6 +1194,13 @@ class ReplicaBalancer:
                         "%d members, %d pending)", load,
                         len(self._parked), len(self._members),
                         len(self._scale_pending))
+                    telemetry.emit(
+                        "autoscale_up", "serving",
+                        load=round(load, 3) if np.isfinite(load)
+                        else "inf",
+                        parked=len(self._parked),
+                        members=len(self._members),
+                        pending=len(self._scale_pending))
                     actions.append(("spawn", None))
                 elif (self._scale_streak["low"]
                         >= int(self.knobs["autoscale_down_after"])
@@ -1150,6 +1222,9 @@ class ReplicaBalancer:
                         "autoscale: scale-down — draining %s "
                         "(load %.2f, %d servable)", victim, load,
                         len(servable))
+                    telemetry.emit(
+                        "autoscale_down", "serving", victim=victim,
+                        load=round(load, 3), servable=len(servable))
         for kind, arg in actions:
             # unlocked on purpose: process spawn/terminate may block,
             # and the serve loop's ledger must keep ticking meanwhile
@@ -1250,6 +1325,8 @@ class ReplicaBalancer:
             self.log.info("rollover to %r started: canary %s (of %d "
                           "ready), parity %s", path, canary,
                           len(ready), parity)
+            telemetry.emit("swap_begin", "serving", path=path,
+                           canary=list(canary), ready=len(ready))
             self._send_front(envelope, self.codec.encode(
                 {"ok": True, "swap_started": True, "req_id": rid,
                  "lb": True, "canary": canary, "generation": old_gen}))
@@ -1315,11 +1392,17 @@ class ReplicaBalancer:
         if result == "promoted":
             self._fleet_path = roll["path"]
             self._m["rollovers"].inc()
+            telemetry.emit("swap_done", "serving", path=roll["path"],
+                           new_gen=roll["new_gen"],
+                           elapsed_s=record["elapsed_s"])
         elif result == "rolled_back":
             # the fleet's intended path is the PRE-wave one: pinning it
             # arms the heal loop against rollback stragglers too
             self._fleet_path = roll["old_path"]
             self._m["rollbacks"].inc()
+            telemetry.emit("rollback", "serving", path=roll["path"],
+                           reason=reason,
+                           elapsed_s=record["elapsed_s"])
         self.log.warning("rollover to %r %s: %s", roll["path"], result,
                          reason)
 
@@ -1330,11 +1413,15 @@ class ReplicaBalancer:
         roll["sent"], roll["warming"] = set(), set()
         roll["done"] = set()
         roll["t_phase"] = time.perf_counter()
+        telemetry.emit("swap_phase", "serving", phase=phase,
+                       path=roll["path"])
 
     def _abort_to_rollback(self, roll: Dict, reason: str) -> None:
         """Warm-phase abort: whatever already flipped rolls back, then
         the wave finishes rolled_back (lock held)."""
         flipped = list(roll["done"])
+        telemetry.emit("rollback", "serving", path=roll["path"],
+                       reason=reason, flipped=len(flipped))
         roll["reason"] = reason
         roll["canary"] = flipped            # only these need undoing
         if not flipped:

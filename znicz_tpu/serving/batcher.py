@@ -813,7 +813,7 @@ class GenSeq:
                  "return_logprobs", "reply_to", "req_id", "trace_id",
                  "client", "t_enqueued", "t_deadline", "pages",
                  "prefilled", "t", "tokens", "logits", "logprobs",
-                 "gen", "t_last", "order")
+                 "gen", "t_last", "order", "t_admitted", "t_first")
 
     def __init__(self, prompt, max_new: int, temperature: float = 0.0,
                  top_k: int = 0, seed=None, stream: bool = False,
@@ -852,6 +852,8 @@ class GenSeq:
         self.gen = None                 # snapshot generation stamp
         self.t_last = None              # last emit time (inter-token)
         self.order = 0                  # arrival index (FIFO grouping)
+        self.t_admitted = None          # admission time (queue-wait end)
+        self.t_first = None             # first-token time (TTFT end)
 
     def sample(self, row) -> int:
         """Next token from one (vocab,) logits row — the HOST sampling
@@ -972,6 +974,28 @@ class GenerationScheduler:
             "inter_token_seconds",
             "gap between consecutive emitted tokens of one sequence",
             size=8192)
+        # ISSUE 20 satellite: TTFT plus its queue-wait/compute split —
+        # where the first-token latency is SPENT, not just its size
+        self._m_ttft = _sc.histogram(
+            "ttft_seconds",
+            "time to first token (enqueue -> first emitted token)",
+            size=2048)
+        self._m_queue_wait = _sc.histogram(
+            "gen_queue_wait_seconds",
+            "pending-queue wait (enqueue -> admission to a KV slot)",
+            size=2048)
+        self._m_compute = _sc.histogram(
+            "gen_compute_seconds",
+            "admission -> first token (prefill compute + tick pacing)",
+            size=2048)
+        #: page-pressure episode latch: journal the TRANSITION into
+        #: pressure once, not every stalled tick
+        self._page_pressure = False
+        self._t_shed_emit = 0.0         # queue-shed journal rate limit
+        #: scheduler spans carry each request's trace_id so the fleet
+        #: exporter can stitch decode/prefill ticks into the request's
+        #: cross-process timeline (ISSUE 20)
+        self._tracer = telemetry.tracer()
         _sc.gauge("kv_occupancy", "allocated KV pages / pool pages",
                   fn=telemetry.weak_fn(self, lambda s: s.gen.occupancy()))
         _sc.gauge("active", "generations holding KV pages",
@@ -1009,6 +1033,18 @@ class GenerationScheduler:
                 return None
             if len(self._pending) >= self.pending_bound:
                 self._m["gen_refused"].inc()
+                now = time.perf_counter()
+                if now - self._t_shed_emit > 1.0:
+                    # journal the shed EPISODE (>= 1/s), not every
+                    # refusal — a flood must not wash the ring
+                    self._t_shed_emit = now
+                    from znicz_tpu import telemetry
+                    telemetry.emit(
+                        "page_shed", "serving", reason="queue_bound",
+                        replica=self.replica_id,
+                        pending=len(self._pending),
+                        bound=self.pending_bound,
+                        active=len(self._active))
                 return Refusal(
                     "shed",
                     f"generation queue at bound ({len(self._pending)} "
@@ -1074,11 +1110,23 @@ class GenerationScheduler:
         self._release(seq)
         self._retire(seq)
         self._m[counter].inc()
+        if self._tracer.enabled and seq.trace_id:
+            # the whole admitted lifetime, tagged for fleet stitching
+            t0 = seq.t_admitted if seq.t_admitted is not None \
+                else seq.t_enqueued
+            t1 = seq.t_last if seq.t_last is not None \
+                else time.perf_counter()
+            self._tracer.add("generate", "sequence", t0,
+                             max(t1 - t0, 0.0),
+                             {"trace_id": seq.trace_id,
+                              "req_id": seq.req_id,
+                              "tokens": len(seq.tokens)})
         rep = {"ok": True, "req_id": seq.req_id,
                "replica_id": self.replica_id,
                "tokens": np.asarray(seq.tokens, np.int32),
                "gen": seq.gen, "prompt_len": seq.prompt_len,
-               "trace_id": seq.trace_id}
+               "trace_id": seq.trace_id,
+               "timing_ms": self._timing_ms(seq)}
         if truncated:
             rep["truncated"] = truncated
         if seq.logits is not None:
@@ -1087,6 +1135,22 @@ class GenerationScheduler:
         if seq.logprobs is not None:
             rep["logprobs"] = np.asarray(seq.logprobs, np.float32)
         replies.append((seq.reply_to, rep))
+
+    @staticmethod
+    def _timing_ms(seq: GenSeq) -> Dict[str, Optional[float]]:
+        """Per-request latency breakdown for the final reply (the
+        frontend's slow-request exemplars render it): where the
+        request's wall time went, in ms.  None where a phase never
+        happened (e.g. expired before admission)."""
+        def ms(a, b):
+            return None if a is None or b is None \
+                else round((b - a) * 1e3, 3)
+
+        end = seq.t_last if seq.t_last is not None else None
+        return {"queue_wait": ms(seq.t_enqueued, seq.t_admitted),
+                "ttft": ms(seq.t_enqueued, seq.t_first),
+                "compute": ms(seq.t_admitted, seq.t_first),
+                "total": ms(seq.t_enqueued, end)}
 
     def _expire(self, seq: GenSeq, replies) -> None:
         import numpy as np
@@ -1112,6 +1176,14 @@ class GenerationScheduler:
             seq.logprobs.append(logp)
         if seq.t_last is not None:
             self._m_inter_token.observe(now - seq.t_last)
+        else:
+            # first token of the sequence: TTFT plus where it went
+            # (queue wait before admission vs compute after)
+            seq.t_first = now
+            self._m_ttft.observe(now - seq.t_enqueued)
+            self._m_compute.observe(now - (seq.t_admitted
+                                           if seq.t_admitted is not None
+                                           else seq.t_enqueued))
         seq.t_last = now
         self._m["generated_tokens"].inc()
         if seq.stream and seq.reply_to is not None:
@@ -1230,6 +1302,8 @@ class GenerationScheduler:
                 admitted.append(self._pending.popleft())
             self._active.extend(admitted)
         for seq in admitted:
+            seq.t_admitted = now
+            self._m_queue_wait.observe(now - seq.t_enqueued)
             if self.gen.prefix is not None:
                 pages, covered = self.gen.prefix.lookup(seq.prompt)
                 seq.pages = pages
@@ -1241,6 +1315,7 @@ class GenerationScheduler:
         # 3. one decode tick over fully-prefilled sequences, grouped by
         # page-table rung — DISPATCHED, not yet fetched
         chunks = []
+        stalled = 0             # rows page-pressure held back this round
         if self._active and now >= self._next_tick:
             groups: Dict[int, List[GenSeq]] = {}
             ticked = False
@@ -1254,6 +1329,7 @@ class GenerationScheduler:
                     continue
                 if not self._page_writable(seq, seq.t
                                            // self.gen.page_size):
+                    stalled += 1
                     continue            # page pressure: stall a tick
                 groups.setdefault(
                     self.gen._page_rung(max(len(seq.pages), 1)),
@@ -1291,6 +1367,8 @@ class GenerationScheduler:
                 break
             if self._ensure_chunk(seq):
                 batch.append(seq)
+            else:
+                stalled += 1
         pf = None
         t0s: List[int] = []
         nn: List[int] = []
@@ -1316,6 +1394,10 @@ class GenerationScheduler:
         for chunk, out in chunks:
             fetched = self._fetch(chunk, out)
             t_emit = time.perf_counter()
+            if self._tracer.enabled:
+                self._tracer.add(
+                    "generate", "decode_tick", now, t_emit - now,
+                    {"trace_id": chunk[0].trace_id, "rows": len(chunk)})
             for i, seq in enumerate(chunk):
                 seq.t += 1
                 seq.gen = out[3]
@@ -1325,6 +1407,11 @@ class GenerationScheduler:
         if pf is not None:
             fetched = self._fetch(batch, pf)
             t_emit = time.perf_counter()
+            if self._tracer.enabled:
+                self._tracer.add(
+                    "generate", "prefill_chunk", now, t_emit - now,
+                    {"trace_id": batch[0].trace_id, "rows": len(batch),
+                     "tokens": sum(nn)})
             for i, seq in enumerate(batch):
                 seq.prefilled = t0s[i] + nn[i]
                 if seq.prefilled < seq.prompt_len:
@@ -1337,7 +1424,22 @@ class GenerationScheduler:
                 self._emit_row(seq, i, fetched, t_emit, replies)
                 if len(seq.tokens) >= seq.max_new:
                     self._final(seq, replies)
+        self._note_page_pressure(stalled, now)
         return worked, replies
+
+    def _note_page_pressure(self, stalled: int, now: float) -> None:
+        """Journal the page-pressure TRANSITION: the first round where
+        allocation held rows back after a clean round emits ONE event
+        with the load numbers; subsequent stalled rounds of the same
+        episode stay silent (the latch resets on a clean round)."""
+        if stalled and not self._page_pressure:
+            from znicz_tpu import telemetry
+            telemetry.emit(
+                "page_shed", "serving", reason="page_pressure",
+                replica=self.replica_id, stalled_rows=stalled,
+                kv_occupancy=round(self.gen.occupancy(), 4),
+                active=len(self._active))
+        self._page_pressure = bool(stalled)
 
     def drain(self) -> List:
         """Abandon every queued/live generation (service shutdown):
@@ -1372,6 +1474,22 @@ class GenerationScheduler:
                 "inter_token_p99_ms":
                 round(float(np.percentile(w, 99)) * 1e3, 3)}
 
+    def ttft_quantiles(self) -> Dict[str, Optional[float]]:
+        """TTFT and its queue-wait/compute split, p50/p99 in ms (None
+        on an empty window) — the web panel's generation row."""
+        import numpy as np
+
+        out: Dict[str, Optional[float]] = {}
+        for key, hist in (("ttft", self._m_ttft),
+                          ("queue_wait", self._m_queue_wait),
+                          ("compute", self._m_compute)):
+            w = hist.window()
+            for q in (50, 99):
+                out[f"{key}_p{q}_ms"] = (
+                    None if w.size == 0
+                    else round(float(np.percentile(w, q)) * 1e3, 3))
+        return out
+
     def stats(self) -> Dict:
         with self._lock:
             pending = len(self._pending)
@@ -1383,6 +1501,7 @@ class GenerationScheduler:
                "on_device_sampling": self.on_device}
         out.update({name: self._m[name].value for name in self.COUNTERS})
         out.update(self.inter_token_quantiles())
+        out.update(self.ttft_quantiles())
         out.update({k: v for k, v in self.gen.stats().items()
                     if k != "jit_cache_size"})
         return out

@@ -133,6 +133,11 @@ class InferenceClient:
                   fn=telemetry.weak_fn(
                       self, lambda c: {"closed": 0.0, "half_open": 0.5,
                                        "open": 1.0}[c._breaker.state]))
+        self._tracer = telemetry.tracer()
+        #: req_id -> (trace_id, t_submitted) for the client-side
+        #: request span (ISSUE 20 fleet stitching); popped wherever
+        #: _pending is, so it stays bounded by requests in flight
+        self._obs_req: Dict[int, tuple] = {}
         self._ids = itertools.count(1)
         #: req_id -> [frames, t_last_sent, resends]
         self._pending: Dict[int, List] = {}
@@ -185,7 +190,9 @@ class InferenceClient:
         payload, _ = wire.encode_message(msg)
         frames = [b""] + payload
         self._sock.send_multipart(frames, copy=False)
-        self._pending[rid] = [frames, time.perf_counter(), 0]
+        now = time.perf_counter()
+        self._pending[rid] = [frames, now, 0]
+        self._obs_req[rid] = (msg.get("trace_id"), now)
         return rid
 
     # -- circuit breaker -------------------------------------------------------
@@ -329,6 +336,7 @@ class InferenceClient:
                 del self._pending[rid]
                 self._on_token.pop(rid, None)
                 self._results[rid] = rep
+                self._note_reply(rid, rep)
                 # breaker outcome: ok replies and PER-CLIENT refusals
                 # count as healthy; only a SERVICE-scoped shed (global
                 # queue at bound) means the service itself is
@@ -370,6 +378,26 @@ class InferenceClient:
                 self._breaker_record(None, False)
             # else: duplicate (our resend raced the original) — dropped
 
+    def _note_reply(self, rid, rep: dict) -> None:
+        """Close out one request's client-side observability (ISSUE
+        20): a ``client/request`` span covering submit→reply, plus
+        ingestion of the server-side span summary the reply may carry —
+        the caller's process (often the fleet coordinator's) gets the
+        remote half of the stitched timeline for free."""
+        tid, t0 = self._obs_req.pop(rid, (None, None))
+        if not self._tracer.enabled:
+            return
+        if tid is not None and t0 is not None:
+            self._tracer.add("client", "request", t0,
+                             time.perf_counter() - t0,
+                             {"trace_id": tid, "req_id": rid,
+                              "ok": bool(rep.get("ok"))})
+        if rep.get("spans") and rep.get("origin"):
+            from znicz_tpu import telemetry
+
+            telemetry.fleet_trace().ingest(str(rep["origin"]),
+                                           rep["spans"])
+
     def _maybe_resend(self) -> None:
         now = time.perf_counter()
         for rid, entry in list(self._pending.items()):
@@ -396,6 +424,7 @@ class InferenceClient:
                              f"— giving up (max_resends="
                              f"{self.max_resends}); service at "
                              f"{self.endpoint} unreachable?"}
+                self._note_reply(rid, self._results[rid])
                 continue
             # the SAME encoded frames: bytes, not re-serialization
             self._sock.send_multipart(frames, copy=False)
@@ -412,6 +441,7 @@ class InferenceClient:
         while req_id not in self._results:
             if time.perf_counter() > deadline:
                 self._pending.pop(req_id, None)
+                self._obs_req.pop(req_id, None)
                 self._m["give_ups"].inc()
                 self._breaker_record(req_id, False)
                 raise TimeoutError(f"req {req_id}: no reply within "

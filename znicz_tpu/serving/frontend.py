@@ -107,6 +107,19 @@ DEFAULTS = {"max_batch": 32, "max_delay_ms": 5.0, "queue_bound": 256,
             # /readyz on this host).  Off by default: long-lived
             # replicas pay nothing
             "aot_cache": {"enabled": False, "dir": ""},
+            # fleet observability (ISSUE 20; read through a local alias
+            # like the admission subtree): slow-request exemplar window
+            # (the N slowest requests with their span breakdown on
+            # /status.json), the heartbeat metrics-snapshot cadence
+            # (every Nth beat carries the full registry snapshot), and
+            # the serving-plane SLO objectives — ADVISORY burn rates on
+            # /slo.json and a new /readyz field, never a gate flip
+            "obs": {"exemplars": 8, "exemplar_window_s": 60.0,
+                    "metrics_every_beats": 8,
+                    "slo_availability": 0.999, "slo_p99_ms": 250.0,
+                    "slo_ttft_ms": 500.0, "slo_inter_token_ms": 100.0,
+                    "slo_fast_window_s": 60.0,
+                    "slo_slow_window_s": 600.0},
             "admission": {"enabled": True, "rate_limit": 0.0,
                           "rate_burst": 0.0, "fair": True, "quantum": 0,
                           "client_queue_bound": 0},
@@ -368,6 +381,46 @@ class InferenceServer:
         self.heartbeat_s = float(bal.get("heartbeat_s",
                                          d_bal["heartbeat_s"]))
         self._tracer = telemetry.tracer()
+        # -- fleet observability (ISSUE 20; knobs read through a local
+        # alias like the admission subtree): this replica's fleet
+        # identity, the span exporter the heartbeat/reply carriers
+        # drain, the slow-request exemplar window, and the serving SLO
+        # tracker (advisory burn rates — /readyz reports, never gates)
+        d_obs = DEFAULTS["obs"]
+        obs = root.common.serving.obs
+        telemetry.set_identity(self.replica_id)
+        self._exporter = telemetry.exporter()
+        self._exemplar_cap = int(obs.get("exemplars", d_obs["exemplars"]))
+        self._exemplar_window_s = float(obs.get(
+            "exemplar_window_s", d_obs["exemplar_window_s"]))
+        self._metrics_every = max(1, int(obs.get(
+            "metrics_every_beats", d_obs["metrics_every_beats"])))
+        self._exemplars: List[Dict] = []    # N slowest, newest window
+        self._exemplar_lock = threading.Lock()
+        self._hb_beats = 0
+        self._hb_ev_seq = 0                 # journal piggyback cursor
+        self.slo = telemetry.register_slo(telemetry.SloTracker(
+            "serving",
+            window_fast_s=float(obs.get("slo_fast_window_s",
+                                        d_obs["slo_fast_window_s"])),
+            window_slow_s=float(obs.get("slo_slow_window_s",
+                                        d_obs["slo_slow_window_s"]))))
+        self.slo.add_objective(
+            "availability",
+            target=float(obs.get("slo_availability",
+                                 d_obs["slo_availability"])))
+        self.slo.add_objective(
+            "latency_p99", target=0.99, unit="s",
+            threshold=float(obs.get("slo_p99_ms",
+                                    d_obs["slo_p99_ms"])) / 1e3)
+        self.slo.add_objective(
+            "ttft", target=0.99, unit="s",
+            threshold=float(obs.get("slo_ttft_ms",
+                                    d_obs["slo_ttft_ms"])) / 1e3)
+        self.slo.add_objective(
+            "inter_token", target=0.99, unit="s",
+            threshold=float(obs.get("slo_inter_token_ms",
+                                    d_obs["slo_inter_token_ms"])) / 1e3)
         self.started_at: Optional[float] = None
         #: optional FaultSchedule for the router loop's built-in
         #: ingress fault hook (ISSUE 14 cross-plane soak); the live
@@ -435,7 +488,33 @@ class InferenceServer:
     def heartbeat_payload(self) -> Dict:
         """One heartbeat message (ISSUE 12): membership identity plus
         the piggybacked ``/readyz`` state, queue depth and per-bucket
-        p99 the balancer's least-loaded dispatch keys on."""
+        p99 the balancer's least-loaded dispatch keys on.
+
+        Fleet observability (ISSUE 20) rides the same beat: a bounded
+        batch of exported spans and fresh journal events on EVERY beat,
+        the full registry snapshot every ``metrics_every_beats``-th —
+        the balancer merges all three into the fleet plane.  The extra
+        keys are additive; a pre-ISSUE-20 balancer ignores them."""
+        from znicz_tpu import telemetry
+
+        hb = self._heartbeat_base()
+        hb["origin"] = telemetry.identity()
+        spans = self._exporter.drain(telemetry.span_export_batch())
+        if spans:
+            hb["spans"] = spans
+        ev = telemetry.journal().since(self._hb_ev_seq,
+                                       limit=telemetry.span_export_batch())
+        if ev:
+            self._hb_ev_seq = ev[-1]["seq"]
+            hb["events"] = ev
+        self._hb_beats += 1
+        if self._hb_beats % self._metrics_every == 1 \
+                or self._metrics_every == 1:
+            hb["metrics"] = telemetry.registry_snapshot(
+                telemetry.registry())
+        return hb
+
+    def _heartbeat_base(self) -> Dict:
         return {"cmd": "heartbeat",
                 "replica_id": self.replica_id,
                 "endpoint": self.endpoint,
@@ -458,6 +537,49 @@ class InferenceServer:
                 "warm_misses": int(self.runner._warm["misses"]),
                 "boot_s": self.boot_to_ready_s,
                 "p99_ms_by_bucket": self.p99_ms_by_bucket()}
+
+    def _note_request(self, ok: bool, latency_s: float, req_id,
+                      trace_id, bucket=None, kind: str = "infer",
+                      breakdown: Optional[Dict] = None) -> None:
+        """Feed one finished request into the SLO tracker and (when it
+        ranks) the slow-request exemplar window (ISSUE 20).  The span
+        peek runs ONLY for requests slow enough to keep — the hot loop
+        pays one lock + one float compare."""
+        self.slo.record("availability", ok)
+        self.slo.record_latency("latency_p99", latency_s)
+        latency_ms = round(latency_s * 1e3, 3)
+        with self._exemplar_lock:
+            now = time.time()
+            horizon = now - self._exemplar_window_s
+            self._exemplars = [e for e in self._exemplars
+                               if e["t"] >= horizon]
+            if len(self._exemplars) >= self._exemplar_cap \
+                    and latency_ms <= self._exemplars[-1]["latency_ms"]:
+                return
+            ex = {"req_id": req_id, "trace_id": trace_id,
+                  "latency_ms": latency_ms, "bucket": bucket,
+                  "kind": kind, "ok": ok, "t": now}
+            if breakdown:
+                ex["breakdown_ms"] = dict(breakdown)
+            if trace_id and self._tracer.enabled:
+                spans = self._exporter.peek_trace(str(trace_id), limit=8)
+                if spans:
+                    ex["spans"] = [{"cat": s.get("cat"),
+                                    "name": s.get("name"),
+                                    "dur_ms": round(
+                                        s.get("dur", 0) / 1e3, 3)}
+                                   for s in spans]
+            self._exemplars.append(ex)
+            self._exemplars.sort(key=lambda e: -e["latency_ms"])
+            del self._exemplars[self._exemplar_cap:]
+
+    def slow_requests(self) -> List[Dict]:
+        """The current exemplar window, slowest first (ISSUE 20
+        satellite — the ``/status.json`` serving panel row)."""
+        horizon = time.time() - self._exemplar_window_s
+        with self._exemplar_lock:
+            return [dict(e) for e in self._exemplars
+                    if e["t"] >= horizon]
 
     def stats(self) -> Dict:
         """The serving panel / bench record, one dict."""
@@ -482,6 +604,7 @@ class InferenceServer:
         out["heartbeats_out"] = self.heartbeats_out
         out["boot_to_ready_s"] = self.boot_to_ready_s
         out["warm_report"] = self.warm_report
+        out["slow_requests"] = self.slow_requests()
         out["batcher"] = self.batcher.stats()
         out["model"] = self.runner.stats()
         if self.gen_sched is not None:
@@ -959,6 +1082,13 @@ class InferenceServer:
                  "replica_id": self.replica_id,
                  "error": f"bad generate parameters: {exc}"}))
             return
+        if self._tracer.enabled:
+            # zero-duration arrival marker: the replica frontend's hop
+            # in the stitched fleet trace (ISSUE 20)
+            self._tracer.add("serving", "generate_rx",
+                             time.perf_counter(), 0.0,
+                             {"trace_id": req.get("trace_id"),
+                              "req_id": rid})
         reason = self.gen_sched.submit(seq)
         if reason is None and dup:
             # a resend matched an in-flight generation: answer with a
@@ -1034,6 +1164,8 @@ class InferenceServer:
                     "error": f"request expired before compute (deadline "
                              f"budget spent queueing; ttl cap "
                              f"{self.request_ttl_s:g}s)"}, None))
+                self._note_request(False, now - r.t_enqueued, r.req_id,
+                                   r.trace_id)
                 continue
             live.append(r)
         if not live:
@@ -1099,6 +1231,8 @@ class InferenceServer:
                     "policy": "deadline", "trace_id": r.trace_id,
                     "error": "result ready past the deadline — dropped, "
                              "not shipped"}, None))
+                self._note_request(False, now - r.t_enqueued, r.req_id,
+                                   r.trace_id, bucket=rung)
                 off += r.n
                 continue
             # slice-copy: each reply owns its rows (the padded tail is
@@ -1123,6 +1257,8 @@ class InferenceServer:
                 "y": np.array(yr)}, r.t_enqueued))
             off += r.n
             self._m["served"].inc()
+            self._note_request(True, now - r.t_enqueued, r.req_id,
+                               r.trace_id, bucket=rung)
 
     def _compute_loop(self) -> None:
         import zmq
@@ -1203,6 +1339,44 @@ class InferenceServer:
         finally:
             wake.close(0)
 
+    def _note_gen_final(self, rep) -> None:
+        """Generation final bookkeeping (ISSUE 20): SLO feeds
+        (availability, TTFT, inter-token from the scheduler's timing
+        breakdown), the slow-request exemplar window, and the
+        stitched-trace reply summary — the replica's spans for this
+        trace ride the final back so the client/balancer can stitch
+        without waiting for the next heartbeat.  Finals only: the
+        infer hot loop and streamed partials never pay this."""
+        from znicz_tpu import telemetry
+
+        if rep.get("rejected"):
+            return              # intentional refusal: not a miss
+        ok = bool(rep.get("ok"))
+        t = rep.get("timing_ms") or {}
+        total = t.get("total")
+        if total is not None:
+            self._note_request(ok, total / 1e3, rep.get("req_id"),
+                               rep.get("trace_id"), kind="generate",
+                               breakdown=t)
+        else:
+            self.slo.record("availability", ok)
+        if ok:
+            ttft = t.get("ttft")
+            if ttft is not None:
+                self.slo.record_latency("ttft", ttft / 1e3)
+                toks = rep.get("tokens")
+                n = int(getattr(toks, "size", 0) or 0)
+                if n > 1 and total is not None and total > ttft:
+                    self.slo.record_latency(
+                        "inter_token",
+                        (total - ttft) / 1e3 / (n - 1))
+        tid = rep.get("trace_id")
+        if ok and tid and self._tracer.enabled:
+            spans = self._exporter.peek_trace(str(tid))
+            if spans:
+                rep["spans"] = spans
+                rep["origin"] = telemetry.identity()
+
     def _ship_gen(self, replies, poke=None) -> None:
         """Queue generation replies for the router thread.  Finals
         count into served/timed_out/rejected (and so toward
@@ -1218,6 +1392,7 @@ class InferenceServer:
                     self._m["timed_out"].inc()
                 else:
                     self._m["rejected"].inc()
+                self._note_gen_final(rep)
             self._outbound.put((env, rep, None))
         if replies and poke is not None:
             poke()

@@ -800,6 +800,13 @@ class PrefixCache:
                 del self._by_page[page]
                 self.gen.decref(page)
                 self.gen._pm["evictions"].inc()
+                # structured journal (ISSUE 20): eviction with the
+                # pressure numbers — the counter above only counts
+                from znicz_tpu import telemetry
+                telemetry.emit(
+                    "prefix_evict", "serving", page=int(page),
+                    indexed=len(self._index),
+                    kv_occupancy=round(self.gen.occupancy(), 4))
                 return True
         return False
 

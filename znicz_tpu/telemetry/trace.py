@@ -93,6 +93,13 @@ class TraceRing:
             maxlen=self.capacity)
         self.recorded = 0               # lifetime count (ring may evict)
         self._count_lock = threading.Lock()
+        self._sinks: List = []          # fleet span exporters (ISSUE 20)
+
+    def add_sink(self, sink) -> None:
+        """Register a callable fed every recorded event tuple (the fleet
+        ``SpanExporter``).  Sinks must be non-blocking and non-raising;
+        the empty-list check keeps the no-sink hot path at one ``if``."""
+        self._sinks.append(sink)
 
     # -- recording -------------------------------------------------------------
 
@@ -110,11 +117,14 @@ class TraceRing:
         timing instead of paying a second pair of clock reads."""
         if not self.enabled:
             return
-        self._events.append((cat, name, int(t0_s * 1e6),
-                             max(int(dur_s * 1e6), 0),
-                             threading.get_ident(), args))
+        evt = (cat, name, int(t0_s * 1e6), max(int(dur_s * 1e6), 0),
+               threading.get_ident(), args)
+        self._events.append(evt)
         with self._count_lock:
             self.recorded += 1
+        if self._sinks:
+            for sink in self._sinks:
+                sink(evt)
 
     def instant(self, cat: str, name: str, **args) -> None:
         """Zero-duration marker event."""

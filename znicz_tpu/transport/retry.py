@@ -257,6 +257,16 @@ class CircuitBreaker:
         self._until = time.perf_counter() + self.backoff.delay(
             self._opens)
         self._on_event("open")
+        # structured journal (ISSUE 20): the breaker-open TRANSITION
+        # with the numbers that drove it — on_event above only counts.
+        # Imported lazily: the journal must stay optional to transport
+        from znicz_tpu import telemetry
+
+        telemetry.emit(
+            "breaker_open", "transport", peer=self.peer,
+            failures=self._outcomes.count(False),
+            window=len(self._outcomes), opens=self._opens,
+            backoff_s=round(self._until - time.perf_counter(), 3))
 
     def record(self, token, ok: bool) -> None:
         """File one outcome.  The armed probe's outcome closes (window

@@ -12,12 +12,21 @@ and the device mesh — over a tiny stdlib ThreadingHTTPServer:
 
 Endpoints: ``/`` (HTML page, auto-refresh), ``/status.json``,
 ``/metrics`` (Prometheus text exposition of the process-wide telemetry
-registry — ISSUE 5), ``/trace.json`` (the telemetry span ring as
-Chrome trace-event JSON; open it in Perfetto), and — for a registered
-inference service (ISSUE 6) — ``/healthz`` (liveness: 200 while the
-serve loop runs, 503 once it died) and ``/readyz`` (readiness: 503
-while warming a snapshot rollover or draining — the membership signal
-the future replica tier's health checks key on).
+registry — ISSUE 5; on a fleet coordinator the same scrape carries
+every member's series too, labeled ``member=<origin>`` — ISSUE 20),
+``/trace.json`` (the telemetry span ring as Chrome trace-event JSON;
+``?fleet=1`` renders the coordinator's STITCHED cross-process timeline
+instead, optionally narrowed with ``&trace_id=``), ``/events.json``
+(the structured event journal; ``since=<seq>`` cursor, ``?fleet=1``
+for the merged fleet journal with its ``mseq`` cursor), ``/slo.json``
+(per-plane SLO burn rates and error-budget state), ``/fleet.json``
+(the structured fleet rollup: merged metrics, stitched-trace summary,
+journal origins, SLO state), and — for a registered inference service
+(ISSUE 6) — ``/healthz`` (liveness: 200 while the serve loop runs, 503
+once it died) and ``/readyz`` (readiness: 503 while warming a snapshot
+rollover or draining — the membership signal the replica tier's health
+checks key on; carries the advisory ``slo`` field, which NEVER flips
+the gate).
 
 Lock discipline (ISSUE 5 de-flake satellite): the ``/metrics`` and
 ``/trace.json`` handlers SNAPSHOT the registry/ring into a plain
@@ -33,6 +42,7 @@ import html
 import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
@@ -316,6 +326,11 @@ class WebStatus:
             def log_message(self, *args):       # silence request logging
                 pass
 
+            def _query(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                return {k: v[-1] for k, v in
+                        urllib.parse.parse_qs(parsed.query).items()}
+
             def do_GET(self):
                 code = 200
                 if self.path.startswith("/healthz"):
@@ -328,7 +343,13 @@ class WebStatus:
                 elif self.path.startswith("/readyz"):
                     # readiness: 503 while warming/draining pulls this
                     # replica out of a load balancer WITHOUT killing it
+                    from znicz_tpu import telemetry
+
                     ready = status.readiness()
+                    # ADVISORY SLO state (ISSUE 20): surfaced for
+                    # operators/dashboards, NEVER part of the gate —
+                    # the 200/503 decision above this line is untouched
+                    ready["slo"] = telemetry.slo_snapshot()["state"]
                     code = 200 if ready["ready"] else 503
                     body = json.dumps(ready).encode()
                     ctype = "application/json"
@@ -338,17 +359,78 @@ class WebStatus:
                 elif self.path.startswith("/metrics"):
                     # Prometheus text exposition (ISSUE 5).  render
                     # returns a COMPLETE string — the socket write below
-                    # happens with no registry lock held
+                    # happens with no registry lock held.  A coordinator
+                    # holding member snapshots (ISSUE 20) renders the
+                    # fleet SUPERSET: local series byte-identical, member
+                    # series appended under the same families with a
+                    # member=<origin> label
                     from znicz_tpu import telemetry
 
-                    body = telemetry.render_prometheus().encode()
+                    store = telemetry.fleet_metrics()
+                    if store.members():
+                        body = telemetry.render_fleet_prometheus(
+                            telemetry.registry(), store).encode()
+                    else:
+                        body = telemetry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.startswith("/trace.json"):
                     # Chrome trace-event JSON of the span ring (open in
-                    # Perfetto); same snapshot-then-write discipline
+                    # Perfetto); same snapshot-then-write discipline.
+                    # ?fleet=1 (ISSUE 20): the coordinator's stitched
+                    # cross-process timeline instead (&trace_id= narrows
+                    # to one request/job)
                     from znicz_tpu import telemetry
 
-                    body = json.dumps(telemetry.chrome_trace()).encode()
+                    q = self._query()
+                    if q.get("fleet"):
+                        trace = telemetry.fleet_trace().chrome_trace(
+                            trace_id=q.get("trace_id"))
+                    else:
+                        trace = telemetry.chrome_trace()
+                    body = json.dumps(trace).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/events.json"):
+                    # the structured event journal (ISSUE 20): bounded,
+                    # seq-cursorable; ?fleet=1 serves the coordinator's
+                    # merged journal on its own mseq cursor
+                    from znicz_tpu import telemetry
+
+                    q = self._query()
+                    try:
+                        since = int(q.get("since", 0))
+                    except ValueError:
+                        since = 0
+                    if q.get("fleet"):
+                        store = telemetry.fleet_events()
+                        payload = {"fleet": True,
+                                   "last_mseq": store.snapshot()["last_mseq"],
+                                   "events": store.since(since)}
+                    else:
+                        j = telemetry.journal()
+                        payload = {"origin": j.origin,
+                                   "last_seq": j.last_seq,
+                                   "dropped": j.dropped,
+                                   "events": j.since(since)}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/slo.json"):
+                    # per-plane SLO burn rates / error-budget state
+                    from znicz_tpu import telemetry
+
+                    body = json.dumps(telemetry.slo_snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/fleet.json"):
+                    # the structured fleet rollup (ISSUE 20)
+                    from znicz_tpu import telemetry
+
+                    ev = telemetry.fleet_events().snapshot()
+                    body = json.dumps({
+                        "metrics": telemetry.fleet_metrics().rollup(),
+                        "trace": telemetry.fleet_trace().snapshot(),
+                        "events": {"last_mseq": ev["last_mseq"],
+                                   "origins": ev["origins"]},
+                        "slo": telemetry.slo_snapshot(),
+                    }).encode()
                     ctype = "application/json"
                 else:
                     snap = status.snapshot()
@@ -570,6 +652,39 @@ class WebStatus:
                                 f"on-device sampling "
                                 f"{'on' if gen['on_device_sampling'] else 'off'}"
                                 f" ({gen['fetch_bytes']} B fetched)</p>")
+                            if "ttft_p50_ms" in gen:
+                                # TTFT + queue-wait vs compute split
+                                # (ISSUE 20): the user-facing latency
+                                # decomposition per generation request
+                                serving_html += (
+                                    f"<p>TTFT p50 {gen['ttft_p50_ms']} ms"
+                                    f" / p99 {gen['ttft_p99_ms']} ms "
+                                    f"(queue-wait p50 "
+                                    f"{gen['queue_wait_p50_ms']} ms / p99 "
+                                    f"{gen['queue_wait_p99_ms']} ms, "
+                                    f"compute p50 "
+                                    f"{gen['compute_p50_ms']} ms / p99 "
+                                    f"{gen['compute_p99_ms']} ms)</p>")
+                        slow = serving.get("slow_requests")
+                        if slow:
+                            # slow-request exemplars (ISSUE 20): the N
+                            # slowest requests of the window, named —
+                            # a p99 regression with req/trace ids
+                            xrows = "".join(
+                                f"<tr><td>{html.escape(str(x['req_id']))}"
+                                f"</td>"
+                                f"<td>{html.escape(str(x.get('trace_id') or '-'))}</td>"
+                                f"<td>{x['latency_ms']}</td>"
+                                f"<td>{html.escape(str(x.get('bucket') or '-'))}</td>"
+                                f"<td>{html.escape(str(x.get('kind') or '-'))}</td>"
+                                f"<td>{html.escape(json.dumps(x.get('breakdown_ms')) if x.get('breakdown_ms') else '-')}</td></tr>"
+                                for x in slow)
+                            serving_html += (
+                                "<h3>Slowest requests (window)</h3>"
+                                "<table border=1><tr><th>req</th>"
+                                "<th>trace</th><th>ms</th><th>bucket</th>"
+                                "<th>kind</th><th>breakdown ms</th></tr>"
+                                f"{xrows}</table>")
                     bal = snap.get("balancer")
                     if bal:
                         # the fleet panel (ISSUE 12): one row per
@@ -665,6 +780,47 @@ class WebStatus:
                                 f"{html.escape(r)}={s['state']}"
                                 f"({s['failures']}/{s['window']})"
                                 for r, s in sorted(rb.items())) + "</p>"
+                    # fleet observability panel (ISSUE 20): SLO
+                    # error-budget state + the journal tail — the
+                    # "why did the fleet do X" answer, on the page
+                    from znicz_tpu import telemetry
+
+                    obs_html = ""
+                    slo = telemetry.slo_snapshot()
+                    if slo["planes"]:
+                        orows = "".join(
+                            f"<tr><td>{html.escape(plane)}</td>"
+                            f"<td>{html.escape(name)}</td>"
+                            f"<td>{o['target']}</td>"
+                            f"<td>{'-' if o['fast_burn'] is None else round(o['fast_burn'], 3)}</td>"
+                            f"<td>{'-' if o['slow_burn'] is None else round(o['slow_burn'], 3)}</td>"
+                            f"<td>{round(o['budget_remaining'], 3)}</td>"
+                            f"<td>{html.escape(o['state'])}</td></tr>"
+                            for plane, p in sorted(slo["planes"].items())
+                            for name, o in sorted(
+                                p["objectives"].items()))
+                        obs_html += (
+                            f"<h2>SLOs ({html.escape(slo['state'])})</h2>"
+                            "<table border=1><tr><th>plane</th>"
+                            "<th>objective</th><th>target</th>"
+                            "<th>fast burn</th><th>slow burn</th>"
+                            "<th>budget left</th><th>state</th></tr>"
+                            f"{orows}</table>")
+                    tail = telemetry.journal().since(
+                        max(0, telemetry.journal().last_seq - 10))
+                    if tail:
+                        erows = "".join(
+                            f"<tr><td>{e['seq']}</td>"
+                            f"<td>{html.escape(e['kind'])}</td>"
+                            f"<td>{html.escape(e['plane'])}</td>"
+                            f"<td>{html.escape(json.dumps({k: v for k, v in e.items() if k not in ('seq', 'ts', 'kind', 'plane', 'origin')}))}"
+                            f"</td></tr>"
+                            for e in reversed(tail))
+                        obs_html += (
+                            "<h2>Event journal (latest)</h2>"
+                            "<table border=1><tr><th>seq</th>"
+                            "<th>kind</th><th>plane</th><th>fields</th>"
+                            f"</tr>{erows}</table>")
                     devs = snap["devices"]
                     dev_text = (f"unavailable — {devs['error']}"
                                 if isinstance(devs, dict)
@@ -677,8 +833,13 @@ class WebStatus:
                         "<tr><th>name</th><th>epoch</th><th>best</th>"
                         f"<th>state</th></tr>{rows}</table>"
                         f"{master_html}{relays_html}{serving_html}"
+                        f"{obs_html}"
                         "<p><a href='/metrics'>/metrics</a> "
                         "<a href='/trace.json'>/trace.json</a> "
+                        "<a href='/trace.json?fleet=1'>?fleet=1</a> "
+                        "<a href='/events.json'>/events.json</a> "
+                        "<a href='/slo.json'>/slo.json</a> "
+                        "<a href='/fleet.json'>/fleet.json</a> "
                         "<a href='/status.json'>/status.json</a> "
                         "<a href='/healthz'>/healthz</a> "
                         "<a href='/readyz'>/readyz</a></p>"
